@@ -1,0 +1,16 @@
+from .base import MXNetError, __version__
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import autograd
+from .ndarray import NDArray
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol, Variable
+from . import executor
+from .executor import Executor
+from . import attribute
+from .attribute import AttrScope
+from . import name
+from .name import NameManager

@@ -1,0 +1,483 @@
+"""Executor — binds a Symbol to devices and arrays and runs it.
+
+Reference: ``include/mxnet/executor.h`` + ``src/executor/graph_executor.cc``
+(2307 LoC). The reference pipeline — ``nnvm::pass::Gradient`` →
+``PlaceDevice`` → ``InferShape`` → ``PlanMemory`` → ``DetectInplaceAddTo`` →
+``AttachOpExecs`` → per-node cached engine ops with bulk segments — exists
+because CUDA kernels launch individually. Here the entire bound graph is
+traced into **one jitted XLA computation**:
+
+* gradient construction = ``jax.grad`` over the traced graph (honouring
+  ``grad_req`` write/add/null, reference ``AggregateGradient``/``_grad_add``
+  semantics via in-jit accumulation);
+* memory planning / inplace / bulk segmentation = XLA buffer assignment and
+  fusion;
+* loss-layer backward conventions (SoftmaxOutput & co ignoring head grads)
+  are honoured because those ops carry ``jax.custom_vjp`` rules.
+
+``forward`` is *lazy*: it records the request and materialises outputs on
+first access. ``backward`` runs a single fused forward+backward program, so a
+``forward → backward → read outputs`` training iteration costs exactly one
+XLA execution — the TPU analogue of the reference's bulk-exec fast path
+(``MXNET_EXEC_BULK_EXEC_TRAIN``, graph_executor.cc:1247-1325).
+
+Monitor/PartialForward-style introspection uses an un-jitted interpret mode
+(SURVEY.md §2.2), matching ``MXExecutorSetMonitorCallback`` behaviour where
+bulk execution disables itself when a monitor is installed
+(graph_executor.cc:1252).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError, np_dtype
+from .context import Context, current_context
+from .ndarray import NDArray, zeros as nd_zeros
+from .ops.registry import OpMode
+
+_GRAD_REQ = ("write", "add", "null")
+
+
+class _CompiledGraph:
+    """The symbol lowered to a pure function over ordered value lists."""
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.topo = symbol._topo()
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self._arg_index = {n: i for i, n in enumerate(self.arg_names)}
+        self._aux_index = {n: i for i, n in enumerate(self.aux_names)}
+        self.heads = symbol._outputs
+        # serial numbers for rng folding — stable across traces
+        self._rng_serial = {}
+        serial = 0
+        for node in self.topo:
+            if not node.is_variable and node.op.need_rng:
+                self._rng_serial[id(node)] = serial
+                serial += 1
+        self.num_rng_ops = serial
+
+    def evaluate(self, arg_vals, aux_vals, rng, is_train, monitor=None):
+        """Run the graph. Returns (head_outputs, aux_updates_list)."""
+        import jax
+
+        env = {}
+        aux_updates = list(aux_vals)
+        for node in self.topo:
+            if node.is_variable:
+                if node.is_aux:
+                    env[id(node)] = [aux_vals[self._aux_index[node.name]]]
+                else:
+                    env[id(node)] = [arg_vals[self._arg_index[node.name]]]
+                continue
+            params = node.params()
+            ins = [env[id(inode)][idx] for (inode, idx) in node.inputs]
+            node_rng = None
+            if node.op.need_rng:
+                node_rng = jax.random.fold_in(rng, self._rng_serial[id(node)])
+            outs, new_aux = node.op.apply(
+                ins, params, OpMode(is_train=is_train, rng=node_rng)
+            )
+            env[id(node)] = outs
+            if new_aux:
+                n_args = len(node.op.arg_names(params))
+                for i, na in enumerate(new_aux):
+                    aux_node = node.inputs[n_args + i][0]
+                    aux_updates[self._aux_index[aux_node.name]] = na
+            if monitor is not None:
+                for i, o in enumerate(outs[: node.op.num_visible_outputs(params)]):
+                    suffix = "_output" if i == 0 else f"_output{i}"
+                    monitor(node.name + suffix, o)
+        head_outs = [env[id(node)][idx] for (node, idx) in self.heads]
+        return head_outs, aux_updates
+
+
+class Executor:
+    """A bound computation (reference ``Executor::Bind``)."""
+
+    def __init__(self, symbol, ctx, args=None, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None,
+                 in_shardings=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self.graph = _CompiledGraph(symbol)
+        self.arg_names = self.graph.arg_names
+        self.aux_names = self.graph.aux_names
+        self.output_names = symbol.list_outputs()
+        self._group2ctx = group2ctx
+        self._in_shardings = dict(in_shardings or {})
+        self._monitor_callback = None
+
+        # --- normalise args ----------------------------------------------
+        self.arg_dict = self._norm_arrays(args, self.arg_names, "args")
+        self.aux_dict = self._norm_arrays(aux_states, self.aux_names, "aux_states")
+        # grad_req per arg
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(self.arg_names, grad_req))
+        elif isinstance(grad_req, dict):
+            self.grad_req = {n: grad_req.get(n, "null") for n in self.arg_names}
+        else:
+            raise MXNetError(f"invalid grad_req {grad_req!r}")
+        for n, r in self.grad_req.items():
+            if r not in _GRAD_REQ:
+                raise MXNetError(f"invalid grad_req {r!r} for {n}")
+        self.grad_dict = self._norm_arrays(
+            args_grad, self.arg_names, "args_grad", allow_missing=True
+        )
+        for n in self.arg_names:
+            if self.grad_req[n] != "null" and n not in self.grad_dict:
+                self.grad_req[n] = "null"
+        self._wrt_names = [
+            n for n in self.arg_names if self.grad_req[n] != "null"
+        ]
+
+        # persistent output handles (rebound in place on every run)
+        self._output_handles = [
+            NDArray(None) for _ in range(len(self.output_names))
+        ]
+        self._pending = None  # None | 'train' | 'eval'
+        self._fresh = False
+        self._step = 0
+        import jax
+
+        self._base_key = jax.random.PRNGKey(0)
+        self._jit_cache = {}
+        if shared_exec is not None:
+            # bucketing: share compiled-function cache and memory with the
+            # master executor (reference shared_exec data_pool_ reuse,
+            # graph_executor.cc:813-817). jax arrays are refcounted so
+            # sharing = simply not duplicating parameter arrays; the jit
+            # cache is shared to reuse traced programs across buckets.
+            self._jit_cache = shared_exec._jit_cache
+
+    # ------------------------------------------------------------------
+    def _norm_arrays(self, arrays, names, what, allow_missing=False):
+        if arrays is None:
+            if allow_missing:
+                return {}
+            if names:
+                raise MXNetError(f"{what}: expected arrays for {names}")
+            return {}
+        if isinstance(arrays, dict):
+            out = {}
+            for n in names:
+                if n in arrays:
+                    if not isinstance(arrays[n], NDArray):
+                        raise MXNetError(f"{what}[{n}] must be NDArray")
+                    out[n] = arrays[n]
+                elif not allow_missing:
+                    raise MXNetError(f"{what}: missing array for {n!r}")
+            return out
+        arrays = list(arrays)
+        if len(arrays) != len(names):
+            raise MXNetError(
+                f"{what}: expected {len(names)} arrays, got {len(arrays)}"
+            )
+        out = {}
+        for n, a in zip(names, arrays):
+            if a is None:
+                if not allow_missing:
+                    raise MXNetError(f"{what}: missing array for {n!r}")
+                continue
+            out[n] = a
+        return out
+
+    # ------------------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self.aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self.output_names, self.outputs))
+
+    # ------------------------------------------------------------------
+    def _arg_vals(self):
+        return [self.arg_dict[n]._data for n in self.arg_names]
+
+    def _aux_vals(self):
+        return [self.aux_dict[n]._data for n in self.aux_names]
+
+    def _rng_key(self):
+        import jax
+
+        key = jax.random.fold_in(self._base_key, self._step)
+        return key
+
+    def _get_jit(self, kind, is_train=False, with_head_grads=False):
+        """Build (lazily) the jitted program for this graph shape-signature."""
+        import jax
+
+        cache_key = (
+            kind,
+            is_train,
+            with_head_grads,
+            tuple((n, self.arg_dict[n].shape, str(self.arg_dict[n].dtype)) for n in self.arg_names),
+            tuple((n, self.aux_dict[n].shape, str(self.aux_dict[n].dtype)) for n in self.aux_names),
+            tuple(self._wrt_names),
+            tuple(sorted((n, r) for n, r in self.grad_req.items())),
+        )
+        fn = self._jit_cache.get(cache_key)
+        if fn is not None:
+            return fn
+        graph = self.graph
+
+        if kind == "forward":
+
+            def _fwd(arg_vals, aux_vals, rng):
+                outs, aux_upd = graph.evaluate(arg_vals, aux_vals, rng, is_train)
+                return outs, aux_upd
+
+            fn = jax.jit(_fwd)
+        elif kind == "train_step":
+            import jax.numpy as jnp
+
+            wrt_idx = [self.arg_names.index(n) for n in self._wrt_names]
+            add_names = [n for n in self._wrt_names if self.grad_req[n] == "add"]
+
+            def _train(arg_vals, aux_vals, rng, head_grads, prev_grads):
+                def loss_fn(wrt_vals):
+                    full = list(arg_vals)
+                    for i, v in zip(wrt_idx, wrt_vals):
+                        full[i] = v
+                    outs, aux_upd = graph.evaluate(full, aux_vals, rng, True)
+                    total = None
+                    for j, o in enumerate(outs):
+                        if not jnp.issubdtype(o.dtype, jnp.floating):
+                            continue
+                        hg = (
+                            head_grads[j]
+                            if head_grads is not None
+                            else jnp.ones_like(o)
+                        )
+                        t = jnp.sum(o.astype(jnp.float32) * hg.astype(jnp.float32))
+                        total = t if total is None else total + t
+                    if total is None:
+                        total = jnp.zeros((), jnp.float32)
+                    return total, (outs, aux_upd)
+
+                wrt_vals = [arg_vals[i] for i in wrt_idx]
+                grads, (outs, aux_upd) = jax.grad(loss_fn, has_aux=True)(wrt_vals)
+                grad_map = dict(zip(self._wrt_names, grads))
+                for n in add_names:
+                    grad_map[n] = grad_map[n] + prev_grads[n]
+                return outs, aux_upd, grad_map
+
+            fn = jax.jit(_train)
+        else:
+            raise MXNetError(f"unknown jit kind {kind}")
+        self._jit_cache[cache_key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        """Bind new input values and schedule a forward pass (lazy)."""
+        import jax
+
+        for name, arr in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument {name!r}")
+            tgt = self.arg_dict[name]
+            src = arr._data if isinstance(arr, NDArray) else jax.numpy.asarray(arr)
+            if tuple(src.shape) != tgt.shape:
+                raise MXNetError(
+                    f"forward: shape mismatch for {name}: bound {tgt.shape}, "
+                    f"got {tuple(src.shape)}"
+                )
+            src = src.astype(tgt.dtype)
+            if name in self._in_shardings:
+                src = jax.device_put(src, self._in_shardings[name])
+            tgt._data = src
+        self._pending = "train" if is_train else "eval"
+        self._fresh = False
+        self._step += 1
+        if self._monitor_callback is not None:
+            self._materialize_forward()
+        return self.outputs
+
+    def _materialize_forward(self):
+        if self._pending is None:
+            return
+        is_train = self._pending == "train"
+        if self._monitor_callback is not None:
+            outs, aux_upd = self.graph.evaluate(
+                self._arg_vals(),
+                self._aux_vals(),
+                self._rng_key(),
+                is_train,
+                monitor=self._monitor_callback,
+            )
+        else:
+            fn = self._get_jit("forward", is_train=is_train)
+            outs, aux_upd = fn(self._arg_vals(), self._aux_vals(), self._rng_key())
+        self._set_outputs(outs)
+        self._set_aux(aux_upd)
+        self._pending = None
+        self._fresh = True
+
+    def _set_outputs(self, outs):
+        for h, o in zip(self._output_handles, outs):
+            h._data = o
+
+    def _set_aux(self, aux_upd):
+        for n, v in zip(self.aux_names, aux_upd):
+            self.aux_dict[n]._data = v
+
+    @property
+    def outputs(self):
+        self._materialize_forward()
+        if not self._fresh and self._output_handles and self._output_handles[0]._data is None:
+            raise MXNetError("outputs accessed before any forward call")
+        return list(self._output_handles)
+
+    def backward(self, out_grads=None, is_train=True):
+        """Fused forward+backward in one XLA program; fills grad arrays."""
+        if self._pending is None and not self._fresh:
+            raise MXNetError("backward called before forward")
+        if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+            out_grads = [out_grads]
+        with_hg = out_grads is not None
+        fn = self._get_jit("train_step", with_head_grads=with_hg)
+        head_grads = None
+        if with_hg:
+            head_grads = [
+                g._data if isinstance(g, NDArray) else g for g in out_grads
+            ]
+        prev = {
+            n: self.grad_dict[n]._data
+            for n in self._wrt_names
+            if self.grad_req[n] == "add"
+        }
+        outs, aux_upd, grad_map = fn(
+            self._arg_vals(), self._aux_vals(), self._rng_key(), head_grads, prev
+        )
+        self._set_outputs(outs)
+        self._set_aux(aux_upd)
+        for n, g in grad_map.items():
+            self.grad_dict[n]._data = g
+        self._pending = None
+        self._fresh = True
+
+    # ------------------------------------------------------------------
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a per-op-output stat callback → interpret mode.
+
+        Mirrors ``MXExecutorSetMonitorCallback``; like the reference, fused
+        execution is disabled while a monitor is installed.
+        """
+        def _cb(name, arr):
+            callback(name, NDArray(arr))
+
+        self._monitor_callback = _cb if callback is not None else None
+
+    def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                arr.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError(f"Found name {name!r} not in executor arguments")
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    arr.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise MXNetError(f"Found name {name!r} not in aux states")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor with new data shapes, sharing parameters."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for n, s in zip(self.arg_names, arg_shapes):
+            cur = self.arg_dict[n]
+            if tuple(cur.shape) == tuple(s):
+                new_args[n] = cur
+            else:
+                if not (partial_shaping or allow_up_sizing or n in kwargs):
+                    raise MXNetError(
+                        f"reshape: shape of {n} changed {cur.shape}->{s}; "
+                        "set partial_shaping=True"
+                    )
+                new_args[n] = nd_zeros(s, dtype=cur.dtype)
+        new_grads = {}
+        for n, g in self.grad_dict.items():
+            s = arg_shapes[self.arg_names.index(n)]
+            new_grads[n] = g if tuple(g.shape) == tuple(s) else nd_zeros(s, dtype=g.dtype)
+        exe = Executor(
+            self._symbol,
+            self._ctx,
+            args=new_args,
+            args_grad=new_grads or None,
+            grad_req=self.grad_req,
+            aux_states=self.aux_dict,
+            group2ctx=self._group2ctx,
+            shared_exec=self,
+            in_shardings=self._in_shardings,
+        )
+        return exe
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def simple_bind(symbol, ctx, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_exec=None, in_shardings=None,
+                    **kwargs):
+        """Infer shapes/dtypes and allocate all arrays (reference
+        ``GraphExecutor::Init`` simple_bind path, graph_executor.cc:852)."""
+        arg_shapes, _out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+        type_dict = dict(type_dict or {})
+        arg_dtypes, _out_dtypes, aux_dtypes = symbol.infer_type(**type_dict)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        args = {}
+        for n, s, d in zip(arg_names, arg_shapes, arg_dtypes):
+            if shared_exec is not None and n in shared_exec.arg_dict and \
+                    tuple(shared_exec.arg_dict[n].shape) == tuple(s):
+                args[n] = shared_exec.arg_dict[n]
+            else:
+                args[n] = nd_zeros(s, ctx=ctx, dtype=d)
+        grad_req_d = (
+            {n: grad_req for n in arg_names}
+            if isinstance(grad_req, str)
+            else (
+                dict(zip(arg_names, grad_req))
+                if isinstance(grad_req, (list, tuple))
+                else {n: grad_req.get(n, "null") for n in arg_names}
+            )
+        )
+        args_grad = {}
+        for n, s, d in zip(arg_names, arg_shapes, arg_dtypes):
+            if grad_req_d.get(n, "null") != "null":
+                if shared_exec is not None and n in shared_exec.grad_dict and \
+                        tuple(shared_exec.grad_dict[n].shape) == tuple(s):
+                    args_grad[n] = shared_exec.grad_dict[n]
+                else:
+                    args_grad[n] = nd_zeros(s, ctx=ctx, dtype=d)
+        aux_states = {}
+        for n, s, d in zip(aux_names, aux_shapes, aux_dtypes):
+            if shared_exec is not None and n in shared_exec.aux_dict and \
+                    tuple(shared_exec.aux_dict[n].shape) == tuple(s):
+                aux_states[n] = shared_exec.aux_dict[n]
+            else:
+                aux_states[n] = nd_zeros(s, ctx=ctx, dtype=d)
+        return Executor(
+            symbol,
+            ctx,
+            args=args,
+            args_grad=args_grad or None,
+            grad_req=grad_req_d,
+            aux_states=aux_states,
+            group2ctx=group2ctx,
+            shared_exec=shared_exec,
+            in_shardings=in_shardings,
+        )

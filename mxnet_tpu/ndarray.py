@@ -1,0 +1,656 @@
+"""NDArray — the imperative array type.
+
+Reference: ``include/mxnet/ndarray.h`` + ``python/mxnet/ndarray.py`` (2359
+LoC). The reference NDArray is a mutable buffer guarded by an engine variable;
+every op pushes an async closure and ``WaitToRead`` blocks on the var queue
+(``src/engine/threaded_engine.h:93-195``).
+
+TPU-native design: an NDArray is a thin mutable *handle* over an immutable
+``jax.Array``. Mutation (in-place ops, ``__setitem__``, ``out=``) rebinds the
+handle to a new functional array — jax's async dispatch plays the role of the
+dependency engine (ordering is by data flow; ``wait_to_read`` ≈
+``block_until_ready``). Ops are generated from the op registry at import
+time, mirroring the reference's codegen from the NNVM registry
+(``python/mxnet/ndarray.py:2204-2356``).
+"""
+
+from __future__ import annotations
+
+import builtins
+import struct
+import sys
+
+import numpy as np
+
+from .base import MXNetError, np_dtype
+from .context import Context, cpu, current_context
+from .ops import registry as _reg
+from .ops.registry import OpMode
+from . import random as _random
+
+
+def _is_np_shape_scalar(x):
+    return isinstance(x, (int, float, bool, np.number))
+
+
+class NDArray:
+    """Mutable handle over a jax.Array."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_autograd_entry", "__weakref__")
+
+    def __init__(self, data, ctx=None):
+        self._data = data
+        self._ctx = ctx
+        self._grad = None
+        self._autograd_entry = None
+
+    # --- basic properties -------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def dtype(self):
+        return np_dtype(self._data.dtype)
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        try:
+            dev = list(self._data.devices())[0]
+        except Exception:
+            return cpu()
+        if dev.platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", getattr(dev, "id", 0))
+
+    ctx = context
+
+    @property
+    def grad(self):
+        return self._grad
+
+    # --- conversion -------------------------------------------------------
+    def asnumpy(self):
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(-1)[0]
+
+    def astype(self, dtype):
+        return NDArray(self._data.astype(np_dtype(dtype)), self._ctx)
+
+    def copy(self):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.asarray(self._data), self._ctx)
+
+    def copyto(self, other):
+        import jax
+
+        if isinstance(other, NDArray):
+            if other is self:
+                return other
+            other._data = jax.device_put(
+                self._data, list(other._data.devices())[0]
+            ).astype(other._data.dtype)
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()), other)
+        raise MXNetError(f"copyto does not support type {type(other)}")
+
+    def as_in_context(self, context):
+        if self.context == context:
+            return self
+        return self.copyto(context)
+
+    def to_device(self, context):
+        return self.as_in_context(context)
+
+    # --- engine facade ----------------------------------------------------
+    def wait_to_read(self):
+        import jax
+
+        jax.block_until_ready(self._data)
+
+    def wait_to_write(self):
+        self.wait_to_read()
+
+    # --- shape ops --------------------------------------------------------
+    def reshape(self, shape, **kwargs):
+        from .ops.defs_tensor import infer_reshape
+
+        if isinstance(shape, int):
+            shape = (shape,)
+        out_shape = infer_reshape(self.shape, tuple(shape), kwargs.get("reverse", False))
+        return NDArray(self._data.reshape(out_shape), self._ctx)
+
+    @property
+    def T(self):
+        return NDArray(self._data.T, self._ctx)
+
+    def transpose(self, axes=None):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.transpose(self._data, axes), self._ctx)
+
+    def flatten(self):
+        return self.reshape((self.shape[0], -1))
+
+    def expand_dims(self, axis):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.expand_dims(self._data, axis), self._ctx)
+
+    def broadcast_to(self, shape):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.broadcast_to(self._data, shape), self._ctx)
+
+    def slice(self, begin, end):
+        return NDArray(
+            self._data[tuple(builtins.slice(b, e) for b, e in zip(begin, end))]
+        )
+
+    def slice_axis(self, axis, begin, end):
+        import jax.lax as lax
+
+        return NDArray(lax.slice_in_dim(self._data, begin, end, axis=axis))
+
+    # --- indexing ---------------------------------------------------------
+    def __getitem__(self, key):
+        data = self._data[key]
+        return NDArray(data, self._ctx)
+
+    def __setitem__(self, key, value):
+        import jax.numpy as jnp
+
+        if isinstance(value, NDArray):
+            v = value._data
+        elif isinstance(value, (np.ndarray, list, tuple, int, float)):
+            v = jnp.asarray(value, dtype=self.dtype)
+        else:
+            v = value
+        if key is Ellipsis or (
+            isinstance(key, builtins.slice) and key == builtins.slice(None)
+        ):
+            self._data = jnp.broadcast_to(
+                jnp.asarray(v, dtype=self.dtype), self.shape
+            )
+        else:
+            self._data = self._data.at[key].set(v)
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __repr__(self):
+        return f"{self.asnumpy()!r}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    # --- arithmetic -------------------------------------------------------
+    def _binary(self, other, fn, reverse=False):
+        import jax.numpy as jnp
+
+        if isinstance(other, NDArray):
+            o = other._data
+        else:
+            o = other
+        a, b = (o, self._data) if reverse else (self._data, o)
+        return NDArray(fn(a, b), self._ctx)
+
+    def __add__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.subtract, reverse=True)
+
+    def __mul__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.divide)
+
+    def __rtruediv__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.divide, reverse=True)
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.mod)
+
+    def __pow__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.power)
+
+    def __neg__(self):
+        return NDArray(-self._data, self._ctx)
+
+    def __abs__(self):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.abs(self._data), self._ctx)
+
+    def _inplace(self, other, fn):
+        import jax.numpy as jnp
+
+        o = other._data if isinstance(other, NDArray) else other
+        self._data = fn(self._data, o)
+        return self
+
+    def __iadd__(self, o):
+        import jax.numpy as jnp
+
+        return self._inplace(o, jnp.add)
+
+    def __isub__(self, o):
+        import jax.numpy as jnp
+
+        return self._inplace(o, jnp.subtract)
+
+    def __imul__(self, o):
+        import jax.numpy as jnp
+
+        return self._inplace(o, jnp.multiply)
+
+    def __itruediv__(self, o):
+        import jax.numpy as jnp
+
+        return self._inplace(o, jnp.divide)
+
+    def _cmp(self, o, fn):
+        import jax.numpy as jnp
+
+        r = self._binary(o, fn)
+        return NDArray(r._data.astype(self.dtype), self._ctx)
+
+    def __eq__(self, o):
+        import jax.numpy as jnp
+
+        if o is None:
+            return False
+        return self._cmp(o, jnp.equal)
+
+    def __ne__(self, o):
+        import jax.numpy as jnp
+
+        if o is None:
+            return True
+        return self._cmp(o, jnp.not_equal)
+
+    def __gt__(self, o):
+        import jax.numpy as jnp
+
+        return self._cmp(o, jnp.greater)
+
+    def __ge__(self, o):
+        import jax.numpy as jnp
+
+        return self._cmp(o, jnp.greater_equal)
+
+    def __lt__(self, o):
+        import jax.numpy as jnp
+
+        return self._cmp(o, jnp.less)
+
+    def __le__(self, o):
+        import jax.numpy as jnp
+
+        return self._cmp(o, jnp.less_equal)
+
+    __hash__ = object.__hash__
+
+    # --- reductions (method forms) ---------------------------------------
+    def sum(self, axis=None, keepdims=False):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.sum(self._data, axis=axis, keepdims=keepdims))
+
+    def mean(self, axis=None, keepdims=False):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.mean(self._data, axis=axis, keepdims=keepdims))
+
+    def max(self, axis=None, keepdims=False):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.max(self._data, axis=axis, keepdims=keepdims))
+
+    def min(self, axis=None, keepdims=False):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.min(self._data, axis=axis, keepdims=keepdims))
+
+    def clip(self, a_min, a_max):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.clip(self._data, a_min, a_max))
+
+    def abs(self):
+        return self.__abs__()
+
+    def argmax(self, axis=None):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.argmax(self._data, axis=axis).astype(self.dtype))
+
+    def argmin(self, axis=None):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.argmin(self._data, axis=axis).astype(self.dtype))
+
+    # --- autograd (imperative) -------------------------------------------
+    def attach_grad(self, grad_req="write"):
+        from . import autograd
+
+        autograd.mark_variable(self, grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from . import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None)
+
+    def detach(self):
+        out = NDArray(self._data, self._ctx)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# creation helpers
+# ---------------------------------------------------------------------------
+def _place(data, ctx):
+    import jax
+
+    if ctx is None:
+        return data
+    return jax.device_put(data, ctx.jax_device())
+
+
+def array(source_array, ctx=None, dtype=None):
+    import jax.numpy as jnp
+
+    if isinstance(source_array, NDArray):
+        src = source_array._data
+        if dtype is not None:
+            src = src.astype(np_dtype(dtype))
+        return NDArray(_place(src, ctx), ctx)
+    arr = np.asarray(source_array, dtype=np_dtype(dtype) if dtype else None)
+    if arr.dtype == np.float64 and dtype is None:
+        arr = arr.astype(np.float32)
+    if arr.dtype == np.int64 and dtype is None and not isinstance(source_array, np.ndarray):
+        arr = arr.astype(np.float32)  # mxnet default dtype is float32
+    return NDArray(_place(jnp.asarray(arr), ctx), ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(jnp.zeros(shape, np_dtype(dtype)), ctx), ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(jnp.ones(shape, np_dtype(dtype)), ctx), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(jnp.full(shape, val, np_dtype(dtype)), ctx), ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    import jax.numpy as jnp
+
+    out = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(_place(out, ctx), ctx)
+
+
+def onehot_encode(indices, out):
+    import jax
+
+    depth = out.shape[1]
+    out._data = jax.nn.one_hot(
+        indices._data.astype("int32"), depth, dtype=out.dtype
+    )
+    return out
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    import jax.numpy as jnp
+
+    return NDArray(jnp.concatenate([a._data for a in arrays], axis=axis))
+
+
+def moveaxis(tensor, source, destination):
+    import jax.numpy as jnp
+
+    return NDArray(jnp.moveaxis(tensor._data, source, destination))
+
+
+def waitall():
+    import jax
+
+    jax.effects_barrier()
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3, mean=None):
+    raise MXNetError("imdecode: use mxnet_tpu.image instead")
+
+
+# ---------------------------------------------------------------------------
+# save / load (reference: src/ndarray/ndarray.cc:806+, binary magic format)
+# ---------------------------------------------------------------------------
+_SAVE_MAGIC = b"MXTPU001"
+
+
+def save(fname, data):
+    """Save NDArrays. Accepts one array, a list, or a dict (like reference).
+
+    Format: custom container — magic, count, then per-entry name + numpy
+    buffer. Readable only by this framework (the reference's binary layout is
+    CUDA-era and not reproduced byte-for-byte), but API-compatible.
+    """
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        items = list(data.items())
+    elif isinstance(data, (list, tuple)):
+        items = [("", d) for d in data]
+    else:
+        raise MXNetError("save: data must be NDArray, list or dict")
+    with open(fname, "wb") as f:
+        f.write(_SAVE_MAGIC)
+        f.write(struct.pack("<q", len(items)))
+        for name, arr in items:
+            if not isinstance(arr, NDArray):
+                raise MXNetError("save: values must be NDArray")
+            nb = name.encode()
+            f.write(struct.pack("<q", len(nb)))
+            f.write(nb)
+            np_arr = arr.asnumpy()
+            header = f"{np_arr.dtype.name}|{','.join(map(str, np_arr.shape))}".encode()
+            f.write(struct.pack("<q", len(header)))
+            f.write(header)
+            buf = np.ascontiguousarray(np_arr).tobytes()
+            f.write(struct.pack("<q", len(buf)))
+            f.write(buf)
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save`. Returns list or dict."""
+    with open(fname, "rb") as f:
+        magic = f.read(len(_SAVE_MAGIC))
+        if magic != _SAVE_MAGIC:
+            raise MXNetError(f"{fname}: not a valid NDArray file")
+        (count,) = struct.unpack("<q", f.read(8))
+        names, arrays = [], []
+        for _ in range(count):
+            (nlen,) = struct.unpack("<q", f.read(8))
+            name = f.read(nlen).decode()
+            (hlen,) = struct.unpack("<q", f.read(8))
+            dtype_s, shape_s = f.read(hlen).decode().split("|")
+            shape = tuple(int(x) for x in shape_s.split(",")) if shape_s else ()
+            (blen,) = struct.unpack("<q", f.read(8))
+            buf = f.read(blen)
+            if dtype_s == "bfloat16":
+                import ml_dtypes
+
+                arr = np.frombuffer(buf, dtype=ml_dtypes.bfloat16).reshape(shape)
+            else:
+                arr = np.frombuffer(buf, dtype=dtype_s).reshape(shape)
+            names.append(name)
+            arrays.append(array(arr, dtype=arr.dtype))
+    if any(names):
+        return dict(zip(names, arrays))
+    return arrays
+
+
+# ---------------------------------------------------------------------------
+# op codegen from the registry
+# ---------------------------------------------------------------------------
+def _make_ndarray_function(opdef, func_name):
+    def generic_op(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        tensor_kwargs = {}
+        param_kwargs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                tensor_kwargs[k] = v
+            else:
+                param_kwargs[k] = v
+        pos = list(args)
+        if "num_args" in opdef.param_schema and "num_args" not in param_kwargs:
+            param_kwargs["num_args"] = len(pos) + len(tensor_kwargs)
+        params = opdef.parse_params(param_kwargs)
+        names = opdef.arg_names(params) + opdef.aux_names(params)
+        inputs = []
+        for nm in names:
+            if nm in tensor_kwargs:
+                inputs.append(tensor_kwargs.pop(nm))
+            elif pos:
+                inputs.append(pos.pop(0))
+            else:
+                raise MXNetError(f"{func_name}: missing input {nm!r}")
+        if pos and not callable(opdef._arg_names):
+            raise MXNetError(f"{func_name}: too many positional inputs")
+        inputs.extend(pos)  # variadic tail
+        arrays = [i._data if isinstance(i, NDArray) else i for i in inputs]
+        from . import autograd
+
+        mode = OpMode(
+            is_train=autograd.is_training(),
+            rng=_random.next_key() if opdef.need_rng else None,
+        )
+        outputs, new_aux = opdef.apply(arrays, params, mode)
+        # write aux updates back into their handles (mutable aux semantics)
+        n_args = len(opdef.arg_names(params))
+        for i, na in enumerate(new_aux):
+            handle = inputs[n_args + i]
+            if isinstance(handle, NDArray):
+                handle._data = na
+        # mutable-input rebinding (optimizer state)
+        arg_names = opdef.arg_names(params)
+        for in_name, out_idx in opdef.mutate:
+            idx = arg_names.index(in_name)
+            if isinstance(inputs[idx], NDArray):
+                inputs[idx]._data = outputs[out_idx]
+        nvis = opdef.num_visible_outputs(params)
+        vis = outputs[:nvis]
+        if autograd.is_recording():
+            in_nds = [i for i in inputs if isinstance(i, NDArray)]
+            out_nds = [NDArray(o) for o in vis]
+            autograd.record_op(opdef, params, in_nds, out_nds, rng=mode.rng)
+        else:
+            out_nds = [NDArray(o) for o in vis]
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o_handle, o_val in zip(outs, vis):
+                o_handle._data = o_val
+            return out
+        if len(out_nds) == 1:
+            return out_nds[0]
+        return out_nds
+
+    generic_op.__name__ = func_name
+    generic_op.__doc__ = opdef.doc or f"{func_name} (op {opdef.name})"
+    return generic_op
+
+
+def _init_ops():
+    module = sys.modules[__name__]
+    for name in _reg.list_ops():
+        opdef = _reg.get(name)
+        if hasattr(module, name):
+            continue  # don't clobber hand-written helpers
+        setattr(module, name, _make_ndarray_function(opdef, name))
+
+
+_init_ops()

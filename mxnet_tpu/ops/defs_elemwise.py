@@ -1,0 +1,215 @@
+"""Elementwise, broadcast and scalar operators.
+
+Reference: ``src/operator/tensor/elemwise_binary_op_basic.cc``,
+``elemwise_unary_op.cc``, ``elemwise_binary_broadcast_op_*.cc``,
+``elemwise_binary_scalar_op_*.cc``, and the scalar functor zoo in
+``src/operator/mshadow_op.h``. Each family there is a hand-written mshadow
+kernel pair (CPU/GPU) plus an FGradient entry; here each is one jnp call and
+XLA fuses chains of them into single HBM-bandwidth-bound kernels — the fusion
+the reference only gets within a single mshadow expression.
+
+Naming parity: the reference registers ``elemwise_add`` (alias ``_plus``),
+``broadcast_add``, ``_plus_scalar`` etc.; python-level sugar (``a + b``) lives
+on the NDArray/Symbol classes and dispatches to these.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import parse_float, parse_bool
+from .registry import Param, register
+
+
+def _simple(n_in):
+    """Wrap a plain array function into the (ins, params, mode) protocol."""
+
+    def deco(jfn):
+        def fn(ins, params, mode):
+            return jfn(*ins, **{k: v for k, v in params.items()})
+
+        return fn
+
+    return deco
+
+
+# --- binary elementwise (same-shape) and broadcast variants ----------------
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "mod": jnp.mod,
+    "hypot": jnp.hypot,
+}
+
+_BINARY_CMP = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less,
+    "lesser_equal": jnp.less_equal,
+}
+
+_ELEMWISE_ALIASES = {
+    "add": ("_plus", "_Plus", "elemwise_add"),
+    "sub": ("_minus", "_Minus", "elemwise_sub"),
+    "mul": ("_mul", "_Mul", "elemwise_mul"),
+    "div": ("_div", "_Div", "elemwise_div"),
+    "power": ("_power", "_Power"),
+    "maximum": ("_maximum", "_Maximum"),
+    "minimum": ("_minimum", "_Minimum"),
+    "mod": ("_mod", "_Mod"),
+}
+
+
+def _as_same_dtype(f, cast_bool=True):
+    def fn(ins, params, mode):
+        a, b = ins
+        out = f(a, b)
+        if cast_bool and out.dtype == jnp.bool_:
+            out = out.astype(a.dtype)
+        return out
+
+    return fn
+
+
+for _n, _f in _BINARY.items():
+    names = _ELEMWISE_ALIASES.get(_n, ())
+    if names:
+        register(
+            names[0],
+            _as_same_dtype(_f, cast_bool=False),
+            arg_names=["lhs", "rhs"],
+            aliases=names[1:],
+        )
+    register(
+        f"broadcast_{_n}",
+        _as_same_dtype(_f, cast_bool=False),
+        arg_names=["lhs", "rhs"],
+        aliases=(f"broadcast_plus",) if _n == "add" else (
+            ("broadcast_minus",) if _n == "sub" else ()),
+    )
+
+for _n, _f in _BINARY_CMP.items():
+    register(f"_{_n}", _as_same_dtype(_f), arg_names=["lhs", "rhs"])
+    register(f"broadcast_{_n}", _as_same_dtype(_f), arg_names=["lhs", "rhs"])
+
+
+# --- scalar variants -------------------------------------------------------
+_SCALAR_SCHEMA = {"scalar": Param(parse_float)}
+
+
+def _scalar_op(f, reverse=False, cast_bool=True):
+    def fn(ins, params, mode):
+        (a,) = ins
+        s = jnp.asarray(params["scalar"], dtype=a.dtype)
+        out = f(s, a) if reverse else f(a, s)
+        if cast_bool and out.dtype == jnp.bool_:
+            out = out.astype(a.dtype)
+        return out
+
+    return fn
+
+
+for _n, _f in _BINARY.items():
+    mxname = {"add": "plus", "sub": "minus"}.get(_n, _n)
+    register(
+        f"_{mxname}_scalar",
+        _scalar_op(_f),
+        arg_names=["data"],
+        param_schema=dict(_SCALAR_SCHEMA),
+        aliases=(f"_{mxname.capitalize()}Scalar",),
+    )
+    if _n in ("sub", "div", "power", "mod"):
+        rname = {"sub": "rminus", "div": "rdiv", "power": "rpower", "mod": "rmod"}[_n]
+        register(
+            f"_{rname}_scalar",
+            _scalar_op(_f, reverse=True),
+            arg_names=["data"],
+            param_schema=dict(_SCALAR_SCHEMA),
+        )
+for _n, _f in _BINARY_CMP.items():
+    register(
+        f"_{_n}_scalar",
+        _scalar_op(_f),
+        arg_names=["data"],
+        param_schema=dict(_SCALAR_SCHEMA),
+    )
+
+
+# --- unary math zoo --------------------------------------------------------
+def _softrelu(x):
+    return jnp.logaddexp(x, 0.0)
+
+
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "round": jnp.round,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.fix,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": lambda x: jax.scipy.special.gammaln(x),
+    "negative": jnp.negative,
+    "reciprocal": lambda x: 1.0 / x,
+    "sigmoid": jax.nn.sigmoid,
+    "relu": jax.nn.relu,
+    "softsign": jax.nn.soft_sign,
+    "erf": jax.scipy.special.erf,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+for _n, _f in _UNARY.items():
+    register(_n, _simple(1)(_f), arg_names=["data"])
+
+
+# --- n-ary sum -------------------------------------------------------------
+def _add_n(ins, params, mode):
+    out = ins[0]
+    for x in ins[1:]:
+        out = out + x
+    return out
+
+
+register(
+    "add_n",
+    _add_n,
+    arg_names=lambda p: [f"arg{i}" for i in range(p["num_args"])],
+    param_schema={"num_args": Param(int)},
+    aliases=("ElementWiseSum", "_sum"),
+)

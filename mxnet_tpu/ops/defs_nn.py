@@ -1,0 +1,936 @@
+"""Neural-network layer operators.
+
+Reference: the legacy ``OperatorProperty`` layers under ``src/operator/``
+(``fully_connected``, ``convolution``, ``batch_norm``, ``pooling``,
+``dropout``, ``softmax_output``, ``lrn``, ``leaky_relu``, ``instance_norm``,
+``l2_normalization``, ``make_loss``, ``regression_output``, ``svm_output``,
+``upsampling``, ``sequence_*``) plus their cuDNN twins. Here each layer is
+one jax function lowered by XLA: convolutions hit the MXU via
+``lax.conv_general_dilated`` (the cuDNN-autotuning machinery in
+``cudnn_algoreg`` has no analogue — XLA picks the algorithm), and loss layers
+encode their reference ``FGradient`` behaviour with ``jax.custom_vjp``.
+
+Layers with state (BatchNorm moving stats) follow the aux-state protocol:
+``fn`` returns ``(outputs, new_aux)`` and the executor writes new_aux back,
+reproducing the reference's mutable ``aux_states`` contract
+(``include/mxnet/operator.h`` Forward aux semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import (
+    MXNetError,
+    np_dtype,
+    parse_bool,
+    parse_float,
+    parse_int,
+    parse_shape,
+    parse_str,
+)
+from .registry import Param, register
+
+
+def _acc(dt):
+    return jnp.float32 if dt in (jnp.bfloat16, jnp.float16) else None
+
+
+def _prec(dt):
+    from .defs_tensor import matmul_precision
+
+    return matmul_precision(dt)
+
+
+# --- FullyConnected --------------------------------------------------------
+def _fc(ins, params, mode):
+    if params["no_bias"]:
+        data, weight = ins
+        bias = None
+    else:
+        data, weight, bias = ins
+    x = data.reshape((data.shape[0], -1))
+    out = jax.lax.dot_general(
+        x,
+        weight,
+        (((1,), (1,)), ((), ())),
+        precision=_prec(x.dtype),
+        preferred_element_type=_acc(x.dtype),
+    ).astype(x.dtype)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _fc_fill(shapes, params):
+    data, *rest = shapes
+    n = params["num_hidden"]
+    if data is not None:
+        in_dim = int(np.prod(data[1:]))
+        if shapes[1] is None:
+            shapes[1] = (n, in_dim)
+    if not params["no_bias"] and shapes[2] is None:
+        shapes[2] = (n,)
+    return shapes
+
+
+register(
+    "FullyConnected",
+    _fc,
+    arg_names=lambda p: ["data", "weight"] + ([] if p["no_bias"] else ["bias"]),
+    param_schema={
+        "num_hidden": Param(parse_int),
+        "no_bias": Param(parse_bool, False),
+        "flatten": Param(parse_bool, True),
+    },
+    fill_in_shapes=_fc_fill,
+)
+
+
+# --- Convolution / Deconvolution ------------------------------------------
+def _conv_dn(ndim):
+    spec = tuple(range(ndim))
+    return jax.lax.ConvDimensionNumbers(spec, spec, spec)
+
+
+def _conv(ins, params, mode):
+    if params["no_bias"]:
+        data, weight = ins
+        bias = None
+    else:
+        data, weight, bias = ins
+    k = params["kernel"]
+    nsp = len(k)
+    stride = params["stride"] or (1,) * nsp
+    dilate = params["dilate"] or (1,) * nsp
+    pad = params["pad"] or (0,) * nsp
+    out = jax.lax.conv_general_dilated(
+        data,
+        weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dn(data.ndim),
+        feature_group_count=params["num_group"],
+        precision=_prec(data.dtype),
+        preferred_element_type=_acc(data.dtype),
+    ).astype(data.dtype)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+def _conv_fill(shapes, params):
+    data = shapes[0]
+    k = params["kernel"]
+    nf = params["num_filter"]
+    ng = params["num_group"]
+    if data is not None and shapes[1] is None:
+        shapes[1] = (nf, data[1] // ng) + tuple(k)
+    if not params["no_bias"] and shapes[2] is None:
+        shapes[2] = (nf,)
+    return shapes
+
+
+_CONV_SCHEMA = {
+    "kernel": Param(parse_shape),
+    "stride": Param(parse_shape, None),
+    "dilate": Param(parse_shape, None),
+    "pad": Param(parse_shape, None),
+    "num_filter": Param(parse_int),
+    "num_group": Param(parse_int, 1),
+    "no_bias": Param(parse_bool, False),
+    "workspace": Param(parse_int, 1024),  # reference knob; XLA manages scratch
+    "cudnn_tune": Param(parse_str, None),  # accepted for script parity, unused
+    "cudnn_off": Param(parse_bool, False),
+    "layout": Param(parse_str, None),
+}
+
+register(
+    "Convolution",
+    _conv,
+    arg_names=lambda p: ["data", "weight"] + ([] if p["no_bias"] else ["bias"]),
+    param_schema=dict(_CONV_SCHEMA),
+    fill_in_shapes=_conv_fill,
+)
+
+
+def _deconv(ins, params, mode):
+    """Transposed convolution = gradient of Convolution wrt its input
+    (reference ``src/operator/deconvolution-inl.h`` computes exactly that via
+    the conv backward kernels). Expressed as lhs-dilated conv so XLA lowers
+    it onto the MXU like any other conv.
+    """
+    if params["no_bias"]:
+        data, weight = ins
+        bias = None
+    else:
+        data, weight, bias = ins
+    k = params["kernel"]
+    nsp = len(k)
+    stride = params["stride"] or (1,) * nsp
+    dilate = params["dilate"] or (1,) * nsp
+    pad = params["pad"] or (0,) * nsp
+    adj = params["adj"] or (0,) * nsp
+    # weight layout (C_in, num_filter//num_group, *k): flip spatially and
+    # swap in/out channels to express deconv as a conv.
+    w = weight
+    for ax in range(2, 2 + nsp):
+        w = jnp.flip(w, axis=ax)
+    ng = params["num_group"]
+    if ng > 1:
+        cin, cpg = w.shape[0], w.shape[1]
+        w = w.reshape((ng, cin // ng) + w.shape[1:])
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape((ng * cpg, cin // ng) + w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    eff_k = tuple((kk - 1) * d + 1 for kk, d in zip(k, dilate))
+    padding = [
+        (ek - 1 - p, ek - 1 - p + a) for ek, p, a in zip(eff_k, pad, adj)
+    ]
+    out = jax.lax.conv_general_dilated(
+        data,
+        w,
+        window_strides=(1,) * nsp,
+        padding=padding,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dn(data.ndim),
+        feature_group_count=ng,
+        precision=_prec(data.dtype),
+        preferred_element_type=_acc(data.dtype),
+    ).astype(data.dtype)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+def _deconv_fill(shapes, params):
+    data = shapes[0]
+    k = params["kernel"]
+    nf = params["num_filter"]
+    ng = params["num_group"]
+    if data is not None and shapes[1] is None:
+        shapes[1] = (data[1], nf // ng) + tuple(k)
+    if not params["no_bias"] and shapes[2] is None:
+        shapes[2] = (nf,)
+    return shapes
+
+
+register(
+    "Deconvolution",
+    _deconv,
+    arg_names=lambda p: ["data", "weight"] + ([] if p["no_bias"] else ["bias"]),
+    param_schema={
+        **_CONV_SCHEMA,
+        "adj": Param(parse_shape, None),
+        "target_shape": Param(parse_shape, None),
+    },
+    fill_in_shapes=_deconv_fill,
+)
+
+
+# --- Activation / LeakyReLU ------------------------------------------------
+_ACTS = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+}
+
+
+def _activation(ins, params, mode):
+    return _ACTS[params["act_type"]](ins[0])
+
+
+register(
+    "Activation",
+    _activation,
+    arg_names=["data"],
+    param_schema={"act_type": Param(parse_str)},
+)
+
+
+def _leaky_relu(ins, params, mode):
+    act = params["act_type"]
+    x = ins[0]
+    if act == "prelu":
+        gamma = ins[1].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x > 0, x, gamma * x)
+    if act == "leaky":
+        s = params["slope"]
+        return jnp.where(x > 0, x, s * x)
+    if act == "elu":
+        s = params["slope"]
+        return jnp.where(x > 0, x, s * jnp.expm1(x))
+    if act == "rrelu":
+        lo, hi = params["lower_bound"], params["upper_bound"]
+        if mode.is_train:
+            slope = jax.random.uniform(
+                mode.rng, x.shape, dtype=x.dtype, minval=lo, maxval=hi
+            )
+        else:
+            slope = (lo + hi) / 2.0
+        return jnp.where(x > 0, x, slope * x)
+    raise MXNetError(f"LeakyReLU: unknown act_type {act}")
+
+
+register(
+    "LeakyReLU",
+    _leaky_relu,
+    arg_names=lambda p: ["data", "gamma"] if p["act_type"] == "prelu" else ["data"],
+    param_schema={
+        "act_type": Param(parse_str, "leaky"),
+        "slope": Param(parse_float, 0.25),
+        "lower_bound": Param(parse_float, 0.125),
+        "upper_bound": Param(parse_float, 0.334),
+    },
+    fill_in_shapes=lambda shapes, p: (
+        [shapes[0], shapes[1] or ((shapes[0][1],) if shapes[0] else None)]
+        if p["act_type"] == "prelu"
+        else shapes
+    ),
+    need_rng=True,
+)
+
+
+# --- BatchNorm -------------------------------------------------------------
+def _batch_norm(ins, params, mode):
+    data, gamma, beta, moving_mean, moving_var = ins
+    eps = params["eps"]
+    momentum = params["momentum"]
+    if params["fix_gamma"]:
+        gamma = jnp.ones_like(gamma)  # constant → zero gradient, as reference
+    axes = tuple(i for i in range(data.ndim) if i != 1)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    use_global = params["use_global_stats"] or not mode.is_train
+    if use_global:
+        mean, var = moving_mean, moving_var
+        new_aux = [moving_mean, moving_var]
+        out_mean, out_var = moving_mean, moving_var
+    else:
+        cdata = data.astype(jnp.float32)
+        mean = jnp.mean(cdata, axis=axes)
+        var = jnp.var(cdata, axis=axes)
+        new_aux = [
+            moving_mean * momentum + jax.lax.stop_gradient(mean) * (1 - momentum),
+            moving_var * momentum + jax.lax.stop_gradient(var) * (1 - momentum),
+        ]
+        out_mean, out_var = mean, var
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps).astype(data.dtype)
+    out = (data - mean.reshape(bshape).astype(data.dtype)) * inv.reshape(
+        bshape
+    ) * gamma.reshape(bshape) + beta.reshape(bshape)
+    return [out, out_mean, out_var], new_aux
+
+
+def _bn_fill(shapes, params):
+    data = shapes[0]
+    if data is not None:
+        c = (data[1],)
+        for i in range(1, 5):
+            if shapes[i] is None:
+                shapes[i] = c
+    return shapes
+
+
+register(
+    "BatchNorm",
+    _batch_norm,
+    arg_names=["data", "gamma", "beta"],
+    aux_names=["moving_mean", "moving_var"],
+    param_schema={
+        "eps": Param(parse_float, 1e-3),
+        "momentum": Param(parse_float, 0.9),
+        "fix_gamma": Param(parse_bool, True),
+        "use_global_stats": Param(parse_bool, False),
+        "output_mean_var": Param(parse_bool, False),
+        "cudnn_off": Param(parse_bool, False),
+        "axis": Param(parse_int, 1),
+    },
+    fill_in_shapes=_bn_fill,
+    num_outputs=3,
+    num_visible_outputs=lambda p: 3 if p["output_mean_var"] else 1,
+)
+
+
+# --- InstanceNorm / L2Normalization ---------------------------------------
+def _instance_norm(ins, params, mode):
+    data, gamma, beta = ins
+    eps = params["eps"]
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    out = (data - mean) * jax.lax.rsqrt(var + eps)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+register(
+    "InstanceNorm",
+    _instance_norm,
+    arg_names=["data", "gamma", "beta"],
+    param_schema={"eps": Param(parse_float, 1e-3)},
+    fill_in_shapes=lambda shapes, p: [
+        shapes[0],
+        shapes[1] or ((shapes[0][1],) if shapes[0] else None),
+        shapes[2] or ((shapes[0][1],) if shapes[0] else None),
+    ],
+)
+
+
+def _l2_normalization(ins, params, mode):
+    (x,) = ins
+    eps = params["eps"]
+    m = params["mode"]
+    if m == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif m == "channel":
+        axes = (1,)
+    elif m == "spatial":
+        axes = tuple(range(2, x.ndim))
+    else:
+        raise MXNetError(f"L2Normalization: unknown mode {m}")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / norm
+
+
+register(
+    "L2Normalization",
+    _l2_normalization,
+    arg_names=["data"],
+    param_schema={
+        "eps": Param(parse_float, 1e-10),
+        "mode": Param(parse_str, "instance"),
+    },
+)
+
+
+# --- LRN -------------------------------------------------------------------
+def _lrn(ins, params, mode):
+    (x,) = ins
+    n = params["nsize"]
+    alpha, beta, knorm = params["alpha"], params["beta"], params["knorm"]
+    sq = jnp.square(x)
+    half = n // 2
+    # cross-channel window sum via pad + reduce_window on channel axis
+    summed = jax.lax.reduce_window(
+        sq,
+        0.0,
+        jax.lax.add,
+        window_dimensions=(1, n) + (1,) * (x.ndim - 2),
+        window_strides=(1,) * x.ndim,
+        padding=((0, 0), (half, half)) + ((0, 0),) * (x.ndim - 2),
+    )
+    norm = jnp.power(knorm + (alpha / n) * summed, -beta)
+    return [x * norm, norm]
+
+
+register(
+    "LRN",
+    _lrn,
+    arg_names=["data"],
+    param_schema={
+        "nsize": Param(parse_int),
+        "alpha": Param(parse_float, 1e-4),
+        "beta": Param(parse_float, 0.75),
+        "knorm": Param(parse_float, 2.0),
+    },
+    num_outputs=2,
+    num_visible_outputs=1,
+)
+
+
+# --- Pooling ---------------------------------------------------------------
+def _pooling(ins, params, mode):
+    (x,) = ins
+    nsp = x.ndim - 2
+    if params["global_pool"]:
+        k = x.shape[2:]
+        stride = (1,) * nsp
+        pad = (0,) * nsp
+    else:
+        k = params["kernel"]
+        stride = params["stride"] or (1,) * nsp
+        pad = params["pad"] or (0,) * nsp
+    ptype = params["pool_type"]
+    pads = []
+    for i in range(nsp):
+        lo = pad[i]
+        hi = pad[i]
+        if params["pooling_convention"] == "full" and not params["global_pool"]:
+            size = x.shape[2 + i]
+            full_out = -(-(size + 2 * pad[i] - k[i]) // stride[i]) + 1
+            valid_out = (size + 2 * pad[i] - k[i]) // stride[i] + 1
+            hi += (full_out - valid_out) * stride[i]
+        pads.append((lo, hi))
+    window = (1, 1) + tuple(k)
+    strides = (1, 1) + tuple(stride)
+    padding = ((0, 0), (0, 0)) + tuple(pads)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, padding)
+    summed = jax.lax.reduce_window(
+        x.astype(jnp.float32), 0.0, jax.lax.add, window, strides, padding
+    )
+    if ptype == "sum":
+        return summed.astype(x.dtype)
+    if ptype == "avg":
+        return (summed / float(np.prod(k))).astype(x.dtype)
+    raise MXNetError(f"Pooling: unknown pool_type {ptype}")
+
+
+register(
+    "Pooling",
+    _pooling,
+    arg_names=["data"],
+    param_schema={
+        "kernel": Param(parse_shape, ()),
+        "pool_type": Param(parse_str, "max"),
+        "global_pool": Param(parse_bool, False),
+        "stride": Param(parse_shape, None),
+        "pad": Param(parse_shape, None),
+        "pooling_convention": Param(parse_str, "valid"),
+        "cudnn_off": Param(parse_bool, False),
+    },
+)
+
+
+# --- Dropout ---------------------------------------------------------------
+def _dropout(ins, params, mode):
+    (x,) = ins
+    p = params["p"]
+    if not mode.is_train or p <= 0.0:
+        return [x, jnp.ones_like(x)]
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(mode.rng, keep, x.shape).astype(x.dtype) / keep
+    return [x * mask, mask]
+
+
+register(
+    "Dropout",
+    _dropout,
+    arg_names=["data"],
+    param_schema={"p": Param(parse_float, 0.5), "mode": Param(parse_str, "training")},
+    need_rng=True,
+    num_outputs=2,
+    num_visible_outputs=1,
+)
+
+
+# --- softmax family --------------------------------------------------------
+register(
+    "softmax",
+    lambda ins, p, m: jax.nn.softmax(ins[0] / p["temperature"], axis=p["axis"]),
+    arg_names=["data"],
+    param_schema={
+        "axis": Param(parse_int, -1),
+        "temperature": Param(parse_float, 1.0),
+    },
+)
+
+register(
+    "log_softmax",
+    lambda ins, p, m: jax.nn.log_softmax(ins[0] / p["temperature"], axis=p["axis"]),
+    arg_names=["data"],
+    param_schema={
+        "axis": Param(parse_int, -1),
+        "temperature": Param(parse_float, 1.0),
+    },
+)
+
+
+def _softmax_activation(ins, params, mode):
+    (x,) = ins
+    if params["mode"] == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+register(
+    "SoftmaxActivation",
+    _softmax_activation,
+    arg_names=["data"],
+    param_schema={"mode": Param(parse_str, "instance")},
+)
+
+
+def _softmax_output(ins, params, mode):
+    """Softmax forward with the classic fused cross-entropy backward.
+
+    Reference ``src/operator/softmax_output-inl.h``: Backward ignores the
+    incoming head gradient entirely and writes ``(p - onehot(label)) *
+    grad_scale`` with optional ignore-label masking and batch/valid
+    normalisation. Encoded with jax.custom_vjp so executor backward() with no
+    out_grads reproduces the loss-layer semantics exactly.
+    """
+    data, label = ins
+    multi = params["multi_output"]
+    preserve = params["preserve_shape"]
+    grad_scale = params["grad_scale"]
+    use_ignore = params["use_ignore"]
+    ignore_label = params["ignore_label"]
+    normalization = params["normalization"]
+
+    def forward(d):
+        if multi:
+            return jax.nn.softmax(d, axis=1)
+        if preserve:
+            return jax.nn.softmax(d, axis=-1)
+        return jax.nn.softmax(d.reshape(d.shape[0], -1), axis=-1).reshape(d.shape)
+
+    @jax.custom_vjp
+    def f(d, l):
+        return forward(d)
+
+    def fwd(d, l):
+        out = forward(d)
+        return out, (out, l)
+
+    def bwd(res, g):
+        out, l = res
+        axis = 1 if multi else out.ndim - 1
+        li = l.astype(jnp.int32)
+        onehot = jax.nn.one_hot(li, out.shape[axis], axis=axis, dtype=out.dtype)
+        grad = out - onehot
+        valid = jnp.ones(l.shape, dtype=out.dtype)
+        if use_ignore:
+            valid = (l != ignore_label).astype(out.dtype)
+            grad = grad * jnp.expand_dims(valid, axis)
+        scale = grad_scale
+        if normalization == "batch":
+            grad = grad / out.shape[0]
+        elif normalization == "valid":
+            grad = grad / jnp.maximum(jnp.sum(valid), 1.0)
+        return grad * scale, jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+def _softmax_output_fill(shapes, params):
+    data = shapes[0]
+    if data is not None and shapes[1] is None:
+        if params["multi_output"]:
+            shapes[1] = (data[0],) + tuple(data[2:])
+        elif params["preserve_shape"]:
+            shapes[1] = tuple(data[:-1])
+        else:
+            shapes[1] = (data[0],)
+    return shapes
+
+
+register(
+    "SoftmaxOutput",
+    _softmax_output,
+    arg_names=["data", "label"],
+    param_schema={
+        "grad_scale": Param(parse_float, 1.0),
+        "ignore_label": Param(parse_float, -1.0),
+        "multi_output": Param(parse_bool, False),
+        "use_ignore": Param(parse_bool, False),
+        "preserve_shape": Param(parse_bool, False),
+        "normalization": Param(parse_str, "null"),
+        "out_grad": Param(parse_bool, False),
+    },
+    fill_in_shapes=_softmax_output_fill,
+    aliases=("Softmax",),
+)
+
+
+# --- losses ----------------------------------------------------------------
+def _make_loss(ins, params, mode):
+    (data,) = ins
+    grad_scale = params["grad_scale"]
+    normalization = params["normalization"]
+    valid_thresh = params["valid_thresh"]
+
+    @jax.custom_vjp
+    def f(d):
+        return d
+
+    def fwd(d):
+        return d, d
+
+    def bwd(d, g):
+        grad = jnp.full_like(d, grad_scale)
+        if normalization == "batch":
+            grad = grad / d.shape[0]
+        elif normalization == "valid":
+            valid = jnp.sum((d > valid_thresh).astype(d.dtype))
+            grad = grad / jnp.maximum(valid, 1.0)
+        return (grad,)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+register(
+    "MakeLoss",
+    _make_loss,
+    arg_names=["data"],
+    param_schema={
+        "grad_scale": Param(parse_float, 1.0),
+        "valid_thresh": Param(parse_float, 0.0),
+        "normalization": Param(parse_str, "null"),
+    },
+    aliases=("make_loss",),
+)
+
+
+def _regression_output(transform, grad_fn):
+    def op(ins, params, mode):
+        data, label = ins
+        grad_scale = params["grad_scale"]
+
+        @jax.custom_vjp
+        def f(d, l):
+            return transform(d)
+
+        def fwd(d, l):
+            out = transform(d)
+            return out, (out, l)
+
+        def bwd(res, g):
+            out, l = res
+            num = float(np.prod(out.shape[1:])) or 1.0
+            grad = grad_fn(out, l.reshape(out.shape)) * (grad_scale / num)
+            return grad, jnp.zeros_like(l)
+
+        f.defvjp(fwd, bwd)
+        return f(data, label)
+
+    return op
+
+
+_REG_SCHEMA = {"grad_scale": Param(parse_float, 1.0)}
+
+register(
+    "LinearRegressionOutput",
+    _regression_output(lambda d: d, lambda o, l: o - l),
+    arg_names=["data", "label"],
+    param_schema=dict(_REG_SCHEMA),
+    fill_in_shapes=lambda shapes, p: [shapes[0], shapes[1] or shapes[0]],
+)
+
+register(
+    "MAERegressionOutput",
+    _regression_output(lambda d: d, lambda o, l: jnp.sign(o - l)),
+    arg_names=["data", "label"],
+    param_schema=dict(_REG_SCHEMA),
+    fill_in_shapes=lambda shapes, p: [shapes[0], shapes[1] or shapes[0]],
+)
+
+register(
+    "LogisticRegressionOutput",
+    _regression_output(jax.nn.sigmoid, lambda o, l: o - l),
+    arg_names=["data", "label"],
+    param_schema=dict(_REG_SCHEMA),
+    fill_in_shapes=lambda shapes, p: [shapes[0], shapes[1] or shapes[0]],
+)
+
+
+def _svm_output(ins, params, mode):
+    data, label = ins
+    margin = params["margin"]
+    coef = params["regularization_coefficient"]
+    use_linear = params["use_linear"]
+
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        li = l.astype(jnp.int32)
+        onehot = jax.nn.one_hot(li, d.shape[1], dtype=d.dtype)
+        score_y = jnp.sum(d * onehot, axis=1, keepdims=True)
+        viol = margin - score_y + d  # margin violation per class
+        mask = ((viol > 0) & (onehot == 0)).astype(d.dtype)
+        if use_linear:
+            grad_wrong = mask
+        else:
+            grad_wrong = 2.0 * viol * mask
+        grad_correct = -jnp.sum(grad_wrong, axis=1, keepdims=True)
+        grad = (grad_wrong + grad_correct * onehot) * coef
+        return grad, jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+register(
+    "SVMOutput",
+    _svm_output,
+    arg_names=["data", "label"],
+    param_schema={
+        "margin": Param(parse_float, 1.0),
+        "regularization_coefficient": Param(parse_float, 1.0),
+        "use_linear": Param(parse_bool, False),
+    },
+    fill_in_shapes=lambda shapes, p: [
+        shapes[0],
+        shapes[1] or ((shapes[0][0],) if shapes[0] else None),
+    ],
+)
+
+
+def _smooth_l1(ins, params, mode):
+    (x,) = ins
+    s2 = params["scalar"] ** 2
+    return jnp.where(
+        jnp.abs(x) < 1.0 / s2, 0.5 * s2 * jnp.square(x), jnp.abs(x) - 0.5 / s2
+    )
+
+
+register(
+    "smooth_l1",
+    _smooth_l1,
+    arg_names=["data"],
+    param_schema={"scalar": Param(parse_float, 1.0)},
+)
+
+
+# --- UpSampling ------------------------------------------------------------
+def _upsampling(ins, params, mode):
+    scale = params["scale"]
+    stype = params["sample_type"]
+    if stype == "nearest":
+        outs = []
+        target = None
+        for x in ins:
+            up = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+            if target is None:
+                target = up.shape[2:]
+            outs.append(up)
+        return jnp.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
+    if stype == "bilinear":
+        data, weight = ins
+        # deconvolution with stride=scale, kernel 2*scale - scale%2
+        k = 2 * scale - scale % 2
+        p = (scale - 1) // 2 if scale % 2 else scale // 2 - 1
+        pad_amt = int(np.ceil((scale - 1) / 2.0))
+        return _deconv(
+            [data, weight],
+            {
+                "kernel": (k, k),
+                "stride": (scale, scale),
+                "pad": (pad_amt, pad_amt),
+                "dilate": (1, 1),
+                "adj": None,
+                "num_filter": params["num_filter"],
+                "num_group": data.shape[1],
+                "no_bias": True,
+                "workspace": 512,
+                "cudnn_tune": None,
+                "cudnn_off": False,
+                "layout": None,
+                "target_shape": None,
+            },
+            mode,
+        )
+    raise MXNetError(f"UpSampling: unknown sample_type {stype}")
+
+
+def _upsampling_args(p):
+    if p["sample_type"] == "bilinear":
+        return ["data", "weight"]
+    return [f"arg{i}" for i in range(p["num_args"])] if p["num_args"] > 1 else ["data"]
+
+
+def _upsampling_fill(shapes, params):
+    if params["sample_type"] == "bilinear" and shapes[0] is not None and shapes[1] is None:
+        scale = params["scale"]
+        k = 2 * scale - scale % 2
+        c = shapes[0][1]
+        shapes[1] = (c, 1, k, k)
+    return shapes
+
+
+register(
+    "UpSampling",
+    _upsampling,
+    arg_names=_upsampling_args,
+    param_schema={
+        "scale": Param(parse_int),
+        "sample_type": Param(parse_str, "nearest"),
+        "num_args": Param(parse_int, 1),
+        "num_filter": Param(parse_int, 0),
+        "multi_input_mode": Param(parse_str, "concat"),
+        "workspace": Param(parse_int, 512),
+    },
+    fill_in_shapes=_upsampling_fill,
+)
+
+
+# --- sequence ops ----------------------------------------------------------
+def _seq_args(p):
+    return ["data", "sequence_length"] if p["use_sequence_length"] else ["data"]
+
+
+_SEQ_SCHEMA = {"use_sequence_length": Param(parse_bool, False)}
+
+
+def _sequence_last(ins, params, mode):
+    x = ins[0]
+    if params["use_sequence_length"]:
+        seqlen = ins[1].astype(jnp.int32)
+        idx = jnp.maximum(seqlen - 1, 0)
+        return jnp.take_along_axis(
+            x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)), axis=0
+        )[0]
+    return x[-1]
+
+
+register(
+    "SequenceLast",
+    _sequence_last,
+    arg_names=_seq_args,
+    param_schema=dict(_SEQ_SCHEMA),
+)
+
+
+def _sequence_mask(ins, params, mode):
+    x = ins[0]
+    if not params["use_sequence_length"]:
+        return x
+    seqlen = ins[1]
+    steps = jnp.arange(x.shape[0]).reshape((-1, 1) + (1,) * (x.ndim - 2))
+    mask = steps < seqlen.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(mask, x, jnp.asarray(params["value"], x.dtype))
+
+
+register(
+    "SequenceMask",
+    _sequence_mask,
+    arg_names=_seq_args,
+    param_schema={**_SEQ_SCHEMA, "value": Param(parse_float, 0.0)},
+)
+
+
+def _sequence_reverse(ins, params, mode):
+    x = ins[0]
+    if not params["use_sequence_length"]:
+        return jnp.flip(x, axis=0)
+    seqlen = ins[1].astype(jnp.int32)
+    steps = jnp.arange(x.shape[0]).reshape(-1, 1)
+    sl = seqlen.reshape(1, -1)
+    rev_idx = jnp.where(steps < sl, sl - 1 - steps, steps)
+    return jnp.take_along_axis(
+        x, rev_idx.reshape(rev_idx.shape + (1,) * (x.ndim - 2)), axis=0
+    )
+
+
+register(
+    "SequenceReverse",
+    _sequence_reverse,
+    arg_names=_seq_args,
+    param_schema=dict(_SEQ_SCHEMA),
+)

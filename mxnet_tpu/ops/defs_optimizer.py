@@ -1,0 +1,161 @@
+"""Fused optimizer update operators.
+
+Reference: ``src/operator/optimizer_op.cc:18-167`` — ``sgd_update``,
+``sgd_mom_update``, ``adam_update``, ``rmsprop_update``,
+``rmspropalex_update``. In the reference these are single fused mshadow
+kernels so the update never materialises intermediates; here each is one jax
+function that XLA fuses the same way. ``mx.optimizer`` calls them with
+``out=weight`` for in-place semantics (handle rebinding at the NDArray layer,
+buffer donation under jit).
+
+All follow the reference's gradient preprocessing: ``grad = rescale_grad *
+grad [+ wd * weight]``, clipped to ``[-clip_gradient, clip_gradient]`` when
+``clip_gradient >= 0`` (clipping applies before wd for sgd/adam, matching
+optimizer_op-inl.h).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import parse_float
+from .registry import Param, register
+
+
+def _common_schema():
+    return {
+        "lr": Param(parse_float),
+        "wd": Param(parse_float, 0.0),
+        "rescale_grad": Param(parse_float, 1.0),
+        "clip_gradient": Param(parse_float, -1.0),
+    }
+
+
+def _prep_grad(grad, weight, params, include_wd=True):
+    g = grad * params["rescale_grad"]
+    clip = params["clip_gradient"]
+    if clip >= 0:
+        g = jnp.clip(g, -clip, clip)
+    if include_wd:
+        g = g + params["wd"] * weight
+    return g
+
+
+def _sgd_update(ins, params, mode):
+    weight, grad = ins
+    g = _prep_grad(grad, weight, params)
+    return weight - params["lr"] * g
+
+
+register(
+    "sgd_update",
+    _sgd_update,
+    arg_names=["weight", "grad"],
+    param_schema=_common_schema(),
+)
+
+
+def _sgd_mom_update(ins, params, mode):
+    weight, grad, mom = ins
+    g = _prep_grad(grad, weight, params)
+    new_mom = params["momentum"] * mom - params["lr"] * g
+    return [weight + new_mom, new_mom]
+
+
+register(
+    "sgd_mom_update",
+    _sgd_mom_update,
+    arg_names=["weight", "grad", "mom"],
+    param_schema={**_common_schema(), "momentum": Param(parse_float, 0.0)},
+    num_outputs=2,
+    num_visible_outputs=1,
+    mutate=[("mom", 1)],
+)
+
+
+def _adam_update(ins, params, mode):
+    weight, grad, mean, var = ins
+    b1, b2, eps = params["beta1"], params["beta2"], params["epsilon"]
+    g = _prep_grad(grad, weight, params)
+    new_mean = b1 * mean + (1.0 - b1) * g
+    new_var = b2 * var + (1.0 - b2) * jnp.square(g)
+    new_weight = weight - params["lr"] * new_mean / (jnp.sqrt(new_var) + eps)
+    return [new_weight, new_mean, new_var]
+
+
+register(
+    "adam_update",
+    _adam_update,
+    arg_names=["weight", "grad", "mean", "var"],
+    param_schema={
+        **_common_schema(),
+        "beta1": Param(parse_float, 0.9),
+        "beta2": Param(parse_float, 0.999),
+        "epsilon": Param(parse_float, 1e-8),
+    },
+    num_outputs=3,
+    num_visible_outputs=1,
+    mutate=[("mean", 1), ("var", 2)],
+)
+
+
+def _rmsprop_update(ins, params, mode):
+    weight, grad, n = ins
+    g = _prep_grad(grad, weight, params)
+    gamma1, eps = params["gamma1"], params["epsilon"]
+    new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
+    delta = params["lr"] * g / jnp.sqrt(new_n + eps)
+    clip_w = params["clip_weights"]
+    new_weight = weight - delta
+    if clip_w > 0:
+        new_weight = jnp.clip(new_weight, -clip_w, clip_w)
+    return [new_weight, new_n]
+
+
+register(
+    "rmsprop_update",
+    _rmsprop_update,
+    arg_names=["weight", "grad", "n"],
+    param_schema={
+        **_common_schema(),
+        "gamma1": Param(parse_float, 0.95),
+        "epsilon": Param(parse_float, 1e-8),
+        "clip_weights": Param(parse_float, -1.0),
+    },
+    num_outputs=2,
+    num_visible_outputs=1,
+    mutate=[("n", 1)],
+)
+
+
+def _rmspropalex_update(ins, params, mode):
+    weight, grad, n, g_, delta = ins
+    g = _prep_grad(grad, weight, params)
+    gamma1, gamma2, eps = params["gamma1"], params["gamma2"], params["epsilon"]
+    new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
+    new_g = (1.0 - gamma1) * g + gamma1 * g_
+    new_delta = gamma2 * delta - params["lr"] * g / jnp.sqrt(
+        new_n - jnp.square(new_g) + eps
+    )
+    new_weight = weight + new_delta
+    clip_w = params["clip_weights"]
+    if clip_w > 0:
+        new_weight = jnp.clip(new_weight, -clip_w, clip_w)
+    return [new_weight, new_n, new_g, new_delta]
+
+
+register(
+    "rmspropalex_update",
+    _rmspropalex_update,
+    arg_names=["weight", "grad", "n", "g", "delta"],
+    param_schema={
+        **_common_schema(),
+        "gamma1": Param(parse_float, 0.95),
+        "gamma2": Param(parse_float, 0.9),
+        "epsilon": Param(parse_float, 1e-8),
+        "clip_weights": Param(parse_float, -1.0),
+    },
+    num_outputs=4,
+    num_visible_outputs=1,
+    mutate=[("n", 1), ("g", 2), ("delta", 3)],
+)

@@ -1,0 +1,223 @@
+"""Random sampling operators.
+
+Reference: ``src/operator/random/sample_op.cc`` (uniform/normal/gamma/
+exponential/poisson/negative_binomial/generalized_negative_binomial) and
+``sample_multinomial_op.cc``. The reference draws from a per-device mshadow
+PRNG handed out by the ResourceManager (``kRandom``); here every sampler
+takes an explicit jax PRNG key through ``OpMode.rng`` — under jit the key is
+a traced input, which is what makes whole training steps replayable from one
+seed (something the reference cannot do across its thread pool).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype, parse_float, parse_int, parse_shape, parse_str
+from .registry import Param, register
+
+
+def _shape_schema():
+    return {
+        "shape": Param(parse_shape, ()),
+        "dtype": Param(parse_str, "float32"),
+        "ctx": Param(parse_str, None),
+    }
+
+
+def _uniform(ins, params, mode):
+    return jax.random.uniform(
+        mode.rng,
+        params["shape"],
+        dtype=np_dtype(params["dtype"]),
+        minval=params["low"],
+        maxval=params["high"],
+    )
+
+
+register(
+    "_random_uniform",
+    _uniform,
+    arg_names=[],
+    param_schema={
+        **_shape_schema(),
+        "low": Param(parse_float, 0.0),
+        "high": Param(parse_float, 1.0),
+    },
+    need_rng=True,
+    infer_dtype=lambda ins, p: [],
+    aliases=("uniform", "random_uniform", "_sample_uniform"),
+)
+
+
+def _normal(ins, params, mode):
+    return (
+        jax.random.normal(mode.rng, params["shape"], dtype=np_dtype(params["dtype"]))
+        * params["scale"]
+        + params["loc"]
+    )
+
+
+register(
+    "_random_normal",
+    _normal,
+    arg_names=[],
+    param_schema={
+        **_shape_schema(),
+        "loc": Param(parse_float, 0.0),
+        "scale": Param(parse_float, 1.0),
+    },
+    need_rng=True,
+    infer_dtype=lambda ins, p: [],
+    aliases=("normal", "random_normal", "_sample_normal"),
+)
+
+
+def _gamma(ins, params, mode):
+    return (
+        jax.random.gamma(
+            mode.rng, params["alpha"], params["shape"], dtype=np_dtype(params["dtype"])
+        )
+        * params["beta"]
+    )
+
+
+register(
+    "_random_gamma",
+    _gamma,
+    arg_names=[],
+    param_schema={
+        **_shape_schema(),
+        "alpha": Param(parse_float, 1.0),
+        "beta": Param(parse_float, 1.0),
+    },
+    need_rng=True,
+    infer_dtype=lambda ins, p: [],
+    aliases=("random_gamma", "_sample_gamma"),
+)
+
+
+def _exponential(ins, params, mode):
+    return (
+        jax.random.exponential(
+            mode.rng, params["shape"], dtype=np_dtype(params["dtype"])
+        )
+        / params["lam"]
+    )
+
+
+register(
+    "_random_exponential",
+    _exponential,
+    arg_names=[],
+    param_schema={**_shape_schema(), "lam": Param(parse_float, 1.0)},
+    need_rng=True,
+    infer_dtype=lambda ins, p: [],
+    aliases=("random_exponential", "_sample_exponential"),
+)
+
+
+def _poisson(ins, params, mode):
+    return jax.random.poisson(mode.rng, params["lam"], params["shape"]).astype(
+        np_dtype(params["dtype"])
+    )
+
+
+register(
+    "_random_poisson",
+    _poisson,
+    arg_names=[],
+    param_schema={**_shape_schema(), "lam": Param(parse_float, 1.0)},
+    need_rng=True,
+    infer_dtype=lambda ins, p: [],
+    aliases=("random_poisson", "_sample_poisson"),
+)
+
+
+def _negative_binomial(ins, params, mode):
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    k, p = params["k"], params["p"]
+    kg, kp = jax.random.split(mode.rng)
+    lam = jax.random.gamma(kg, k, params["shape"]) * ((1.0 - p) / p)
+    return jax.random.poisson(kp, lam).astype(np_dtype(params["dtype"]))
+
+
+register(
+    "_random_negative_binomial",
+    _negative_binomial,
+    arg_names=[],
+    param_schema={
+        **_shape_schema(),
+        "k": Param(parse_int, 1),
+        "p": Param(parse_float, 1.0),
+    },
+    need_rng=True,
+    infer_dtype=lambda ins, p: [],
+    aliases=("random_negative_binomial", "_sample_negbinomial"),
+)
+
+
+def _gen_negative_binomial(ins, params, mode):
+    mu, alpha = params["mu"], params["alpha"]
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    kg, kp = jax.random.split(mode.rng)
+    lam = jax.random.gamma(kg, k, params["shape"]) * ((1.0 - p) / p)
+    return jax.random.poisson(kp, lam).astype(np_dtype(params["dtype"]))
+
+
+register(
+    "_random_generalized_negative_binomial",
+    _gen_negative_binomial,
+    arg_names=[],
+    param_schema={
+        **_shape_schema(),
+        "mu": Param(parse_float, 1.0),
+        "alpha": Param(parse_float, 1.0),
+    },
+    need_rng=True,
+    infer_dtype=lambda ins, p: [],
+    aliases=("random_generalized_negative_binomial", "_sample_gennegbinomial"),
+)
+
+
+def _sample_multinomial(ins, params, mode):
+    (data,) = ins
+    n = params["shape"] or ()
+    num = 1
+    for d in n:
+        num *= d
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    if data.ndim == 1:
+        out = jax.random.categorical(mode.rng, logits, shape=(num,) if n else ())
+        out = out.reshape(n) if n else out
+    else:
+        out = jax.random.categorical(
+            mode.rng, logits[:, None, :], axis=-1, shape=(data.shape[0], num)
+        )
+        out = out.reshape((data.shape[0],) + tuple(n)) if n else out[:, 0]
+    outs = [out.astype(np_dtype(params["dtype"]))]
+    if params["get_prob"]:
+        prob = jnp.take_along_axis(
+            logits if data.ndim > 1 else logits[None],
+            out.reshape((data.shape[0] if data.ndim > 1 else 1, -1)).astype(jnp.int32),
+            axis=-1,
+        ).reshape(out.shape)
+        outs.append(prob)
+    return outs
+
+
+register(
+    "_sample_multinomial",
+    _sample_multinomial,
+    arg_names=["data"],
+    param_schema={
+        "shape": Param(parse_shape, ()),
+        "get_prob": Param(lambda v: str(v).lower() in ("true", "1"), False),
+        "dtype": Param(parse_str, "int32"),
+    },
+    need_rng=True,
+    num_outputs=lambda p: 2 if p["get_prob"] else 1,
+    aliases=("sample_multinomial",),
+)

@@ -1,0 +1,203 @@
+"""Reductions and ordering ops.
+
+Reference: ``src/operator/tensor/broadcast_reduce_op_value.cc``,
+``broadcast_reduce_op_index.cc`` (argmax/argmin), ``ordering_op.cc``
+(topk/sort/argsort). MXNet reduce semantics: ``axis`` may be empty (= all
+axes), ``keepdims``, and ``exclude`` (reduce over the complement of ``axis``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import parse_bool, parse_int, parse_shape, parse_str
+from .registry import Param, register
+
+
+def _norm_axes(ndim, axis, exclude):
+    if axis is None or axis == ():
+        axes = tuple(range(ndim))
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if exclude:
+        axes = tuple(i for i in range(ndim) if i not in axes)
+    return axes
+
+
+def _reduce_schema():
+    return {
+        "axis": Param(parse_shape, None),
+        "keepdims": Param(parse_bool, False),
+        "exclude": Param(parse_bool, False),
+    }
+
+
+def _make_reduce(jfn):
+    def fn(ins, params, mode):
+        (x,) = ins
+        axes = _norm_axes(x.ndim, params["axis"], params["exclude"])
+        return jfn(x, axis=axes, keepdims=params["keepdims"])
+
+    return fn
+
+
+_REDUCERS = {
+    "sum": jnp.sum,
+    "mean": jnp.mean,
+    "prod": jnp.prod,
+    "nansum": jnp.nansum,
+    "nanprod": jnp.nanprod,
+    "max": jnp.max,
+    "min": jnp.min,
+}
+
+_REDUCE_ALIASES = {
+    "sum": ("sum_axis",),
+    "max": ("max_axis",),
+    "min": ("min_axis",),
+}
+
+for _n, _f in _REDUCERS.items():
+    register(
+        _n,
+        _make_reduce(_f),
+        arg_names=["data"],
+        param_schema=_reduce_schema(),
+        aliases=_REDUCE_ALIASES.get(_n, ()),
+    )
+
+
+def _norm(ins, params, mode):
+    (x,) = ins
+    return jnp.sqrt(jnp.sum(jnp.square(x))).reshape(1)
+
+
+register("norm", _norm, arg_names=["data"])
+
+
+# --- arg reductions --------------------------------------------------------
+def _make_argred(jfn):
+    def fn(ins, params, mode):
+        (x,) = ins
+        ax = params["axis"]
+        out = jfn(x, axis=ax).astype(x.dtype)
+        if params["keepdims"] and ax is not None:
+            out = jnp.expand_dims(out, ax)
+        return out
+
+    return fn
+
+
+for _n, _f in (("argmax", jnp.argmax), ("argmin", jnp.argmin)):
+    register(
+        _n,
+        _make_argred(_f),
+        arg_names=["data"],
+        param_schema={
+            "axis": Param(parse_int, None),
+            "keepdims": Param(parse_bool, False),
+        },
+    )
+
+
+def _argmax_channel(ins, params, mode):
+    (x,) = ins
+    return jnp.argmax(x, axis=1).astype(x.dtype)
+
+
+register("argmax_channel", _argmax_channel, arg_names=["data"])
+
+
+# --- ordering --------------------------------------------------------------
+def _topk(ins, params, mode):
+    (x,) = ins
+    ax = params["axis"]
+    k = params["k"]
+    is_ascend = params["is_ascend"]
+    ret_typ = params["ret_typ"]
+    if ax is None:
+        x = x.reshape(-1)
+        ax = 0
+    ax = ax % x.ndim
+    xm = jnp.moveaxis(x, ax, -1)
+    n = xm.shape[-1]
+    kk = n if k == 0 else k
+    vals = -xm if not is_ascend else xm
+    neg_vals, idx = jax.lax.top_k(-vals if is_ascend else xm, kk)
+    if is_ascend:
+        # top_k gives largest; for ascend take largest of negated
+        top_vals = -neg_vals if False else jnp.take_along_axis(xm, idx, axis=-1)
+    else:
+        top_vals = jnp.take_along_axis(xm, idx, axis=-1)
+    top_vals = jnp.moveaxis(top_vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax)
+    if ret_typ == "value":
+        return top_vals
+    if ret_typ == "indices":
+        return idx.astype(x.dtype)
+    if ret_typ == "both":
+        return [top_vals, idx.astype(x.dtype)]
+    if ret_typ == "mask":
+        oh = jnp.sum(
+            jax.nn.one_hot(jnp.moveaxis(idx, ax, -1), n, dtype=x.dtype), axis=-2
+        )
+        return jnp.moveaxis(oh, -1, ax)
+    raise ValueError(f"topk: unknown ret_typ {ret_typ}")
+
+
+register(
+    "topk",
+    _topk,
+    arg_names=["data"],
+    param_schema={
+        "axis": Param(parse_int, -1),
+        "k": Param(parse_int, 1),
+        "ret_typ": Param(parse_str, "indices"),
+        "is_ascend": Param(parse_bool, False),
+    },
+    num_outputs=lambda p: 2 if p["ret_typ"] == "both" else 1,
+)
+
+
+def _sort(ins, params, mode):
+    (x,) = ins
+    ax = params["axis"]
+    out = jnp.sort(x, axis=ax)
+    if not params["is_ascend"]:
+        out = jnp.flip(out, axis=-1 if ax is None else ax)
+    return out
+
+
+register(
+    "sort",
+    _sort,
+    arg_names=["data"],
+    param_schema={
+        "axis": Param(parse_int, -1),
+        "is_ascend": Param(parse_bool, True),
+    },
+)
+
+
+def _argsort(ins, params, mode):
+    (x,) = ins
+    ax = params["axis"]
+    out = jnp.argsort(x, axis=ax)
+    if not params["is_ascend"]:
+        out = jnp.flip(out, axis=-1 if ax is None else ax)
+    return out.astype(x.dtype)
+
+
+register(
+    "argsort",
+    _argsort,
+    arg_names=["data"],
+    param_schema={
+        "axis": Param(parse_int, -1),
+        "is_ascend": Param(parse_bool, True),
+    },
+)

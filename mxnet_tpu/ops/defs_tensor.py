@@ -1,0 +1,649 @@
+"""Shape/layout/indexing/matrix operators.
+
+Reference: ``src/operator/tensor/matrix_op.cc`` (reshape/transpose/dot/slice/
+clip/repeat/tile/reverse), ``indexing_op.cc`` (Embedding/take/one_hot/pick),
+``init_op.cc`` (zeros/ones/arange), ``ordering_op.cc`` (topk/sort/argmax),
+``control_flow_op.cc`` (where), ``concat.cc``, ``slice_channel.cc``,
+``pad.cc``, ``swapaxis.cc``, ``cast``. MXNet's ``Reshape`` special codes
+(0/-1/-2/-3/-4, see matrix_op-inl.h) are reproduced exactly since saved
+symbols depend on them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import (
+    MXNetError,
+    np_dtype,
+    parse_bool,
+    parse_float,
+    parse_int,
+    parse_shape,
+    parse_str,
+)
+from .registry import Param, register
+
+
+# --- dot / batch_dot -------------------------------------------------------
+def matmul_precision(dt):
+    """MXU precision policy: float32 contractions run at HIGHEST (f32
+    numerics, parity with the reference's cuBLAS f32 path); bf16/f16 inputs
+    use native MXU passes with f32 accumulation via preferred_element_type.
+    Without this, TPU's default bf16 matmul silently loses ~3 decimal digits
+    on f32 data."""
+    if dt in (jnp.bfloat16, jnp.float16):
+        return None
+    return jax.lax.Precision.HIGHEST
+
+
+def _dot(ins, params, mode):
+    a, b = ins
+    if params["transpose_a"]:
+        a = a.T if a.ndim == 2 else jnp.transpose(a)
+    if params["transpose_b"]:
+        b = b.T if b.ndim == 2 else jnp.transpose(b)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b, precision=matmul_precision(a.dtype)).reshape(1)
+    # MXNet dot contracts last axis of a with first axis of b.
+    return jax.lax.dot_general(
+        a,
+        b,
+        (((a.ndim - 1,), (0,)), ((), ())),
+        precision=matmul_precision(a.dtype),
+        preferred_element_type=_acc_type(a.dtype),
+    ).astype(jnp.result_type(a.dtype, b.dtype))
+
+
+def _acc_type(dt):
+    return jnp.float32 if dt in (jnp.bfloat16, jnp.float16) else None
+
+
+register(
+    "dot",
+    _dot,
+    arg_names=["lhs", "rhs"],
+    param_schema={
+        "transpose_a": Param(parse_bool, False),
+        "transpose_b": Param(parse_bool, False),
+    },
+)
+
+
+def _batch_dot(ins, params, mode):
+    a, b = ins
+    if params["transpose_a"]:
+        a = jnp.swapaxes(a, -1, -2)
+    if params["transpose_b"]:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b, precision=matmul_precision(a.dtype))
+
+
+register(
+    "batch_dot",
+    _batch_dot,
+    arg_names=["lhs", "rhs"],
+    param_schema={
+        "transpose_a": Param(parse_bool, False),
+        "transpose_b": Param(parse_bool, False),
+    },
+)
+
+
+# --- reshape with MXNet special codes --------------------------------------
+def infer_reshape(data_shape, target, reverse=False):
+    """Compute the MXNet Reshape output shape (matrix_op-inl.h semantics)."""
+    if reverse:
+        data_shape = tuple(reversed(data_shape))
+        target = tuple(reversed(target))
+        # note: -4's two trailing args also reverse; handled by recursion
+        out = infer_reshape(data_shape, target, reverse=False)
+        return tuple(reversed(out))
+    src = list(data_shape)
+    out = []
+    src_idx = 0
+    infer_idx = -1
+    i = 0
+    while i < len(target):
+        t = target[i]
+        if t == 0:
+            out.append(src[src_idx])
+            src_idx += 1
+        elif t == -1:
+            if infer_idx >= 0:
+                raise MXNetError("Reshape: more than one -1")
+            infer_idx = len(out)
+            out.append(1)
+            src_idx += 1
+        elif t == -2:
+            out.extend(src[src_idx:])
+            src_idx = len(src)
+        elif t == -3:
+            out.append(src[src_idx] * src[src_idx + 1])
+            src_idx += 2
+        elif t == -4:
+            d1, d2 = target[i + 1], target[i + 2]
+            d = src[src_idx]
+            if d1 == -1:
+                d1 = d // d2
+            if d2 == -1:
+                d2 = d // d1
+            out.extend([d1, d2])
+            src_idx += 1
+            i += 2
+        else:
+            out.append(t)
+            src_idx = min(src_idx + 1, len(src))
+        i += 1
+    total = int(np.prod(data_shape)) if data_shape else 1
+    if infer_idx >= 0:
+        known = int(np.prod([d for j, d in enumerate(out) if j != infer_idx]))
+        out[infer_idx] = total // known
+    if int(np.prod(out)) != total:
+        raise MXNetError(
+            f"Reshape: cannot reshape {data_shape} into {target} (got {out})"
+        )
+    return tuple(out)
+
+
+def _reshape(ins, params, mode):
+    (x,) = ins
+    out_shape = infer_reshape(x.shape, params["shape"], params["reverse"])
+    return jnp.reshape(x, out_shape)
+
+
+register(
+    "Reshape",
+    _reshape,
+    arg_names=["data"],
+    param_schema={
+        "shape": Param(parse_shape),
+        "reverse": Param(parse_bool, False),
+        "target_shape": Param(parse_shape, None),  # deprecated, ignored
+        "keep_highest": Param(parse_bool, False),  # deprecated, ignored
+    },
+    aliases=("reshape",),
+)
+
+register(
+    "Flatten",
+    lambda ins, p, m: jnp.reshape(ins[0], (ins[0].shape[0], -1)),
+    arg_names=["data"],
+    aliases=("flatten",),
+)
+
+
+def _transpose(ins, params, mode):
+    (x,) = ins
+    axes = params["axes"]
+    if not axes:
+        axes = None
+    return jnp.transpose(x, axes)
+
+
+register(
+    "transpose",
+    _transpose,
+    arg_names=["data"],
+    param_schema={"axes": Param(parse_shape, ())},
+)
+
+register(
+    "expand_dims",
+    lambda ins, p, m: jnp.expand_dims(ins[0], p["axis"]),
+    arg_names=["data"],
+    param_schema={"axis": Param(parse_int)},
+)
+
+
+def _swapaxes(ins, params, mode):
+    return jnp.swapaxes(ins[0], params["dim1"], params["dim2"])
+
+
+register(
+    "SwapAxis",
+    _swapaxes,
+    arg_names=["data"],
+    param_schema={"dim1": Param(parse_int, 0), "dim2": Param(parse_int, 0)},
+    aliases=("swapaxes",),
+)
+
+
+# --- slicing ---------------------------------------------------------------
+def _slice(ins, params, mode):
+    (x,) = ins
+    begin, end = params["begin"], params["end"]
+    idx = []
+    for i in range(x.ndim):
+        b = begin[i] if i < len(begin) and begin[i] is not None else 0
+        e = end[i] if i < len(end) and end[i] is not None else x.shape[i]
+        idx.append(slice(b, e))
+    return x[tuple(idx)]
+
+
+def _parse_shape_opt(v):
+    """Shape tuple that may contain None entries."""
+    if v is None:
+        return ()
+    if isinstance(v, (tuple, list)):
+        return tuple(None if x is None else int(x) for x in v)
+    import ast
+
+    val = ast.literal_eval(str(v).replace("None", "-2147483648"))
+    if isinstance(val, int):
+        val = (val,)
+    return tuple(None if x == -2147483648 else int(x) for x in val)
+
+
+register(
+    "slice",
+    _slice,
+    arg_names=["data"],
+    param_schema={
+        "begin": Param(_parse_shape_opt),
+        "end": Param(_parse_shape_opt),
+    },
+    aliases=("crop",),
+)
+
+
+def _slice_axis(ins, params, mode):
+    (x,) = ins
+    ax = params["axis"]
+    n = x.shape[ax]
+    b = params["begin"] or 0
+    e = params["end"]
+    if b < 0:
+        b += n
+    if e is None:
+        e = n
+    elif e < 0:
+        e += n
+    return jax.lax.slice_in_dim(x, b, e, axis=ax)
+
+
+register(
+    "slice_axis",
+    _slice_axis,
+    arg_names=["data"],
+    param_schema={
+        "axis": Param(parse_int),
+        "begin": Param(parse_int, 0),
+        "end": Param(parse_int, None),
+    },
+)
+
+
+# --- concat / split --------------------------------------------------------
+def _concat(ins, params, mode):
+    return jnp.concatenate(ins, axis=params["dim"])
+
+
+register(
+    "Concat",
+    _concat,
+    arg_names=lambda p: [f"arg{i}" for i in range(p["num_args"])],
+    param_schema={"num_args": Param(int), "dim": Param(parse_int, 1)},
+    aliases=("concat",),
+)
+
+
+def _slice_channel(ins, params, mode):
+    (x,) = ins
+    n = params["num_outputs"]
+    ax = params["axis"]
+    parts = jnp.split(x, n, axis=ax)
+    if params["squeeze_axis"]:
+        parts = [jnp.squeeze(p, axis=ax) for p in parts]
+    return list(parts)
+
+
+register(
+    "SliceChannel",
+    _slice_channel,
+    arg_names=["data"],
+    param_schema={
+        "num_outputs": Param(parse_int),
+        "axis": Param(parse_int, 1),
+        "squeeze_axis": Param(parse_bool, False),
+    },
+    num_outputs=lambda p: p["num_outputs"],
+    aliases=("split",),
+)
+
+
+def _stack(ins, params, mode):
+    return jnp.stack(ins, axis=params["axis"])
+
+
+register(
+    "stack",
+    _stack,
+    arg_names=lambda p: [f"arg{i}" for i in range(p["num_args"])],
+    param_schema={"num_args": Param(int), "axis": Param(parse_int, 0)},
+)
+
+
+# --- indexing --------------------------------------------------------------
+def _take(ins, params, mode):
+    data, indices = ins
+    ax = params["axis"]
+    mmode = params["mode"]
+    idx = indices.astype(jnp.int32)
+    if mmode == "clip":
+        idx = jnp.clip(idx, 0, data.shape[ax] - 1)
+    elif mmode == "wrap":
+        idx = jnp.mod(idx, data.shape[ax])
+    return jnp.take(data, idx, axis=ax)
+
+
+register(
+    "take",
+    _take,
+    arg_names=["a", "indices"],
+    param_schema={
+        "axis": Param(parse_int, 0),
+        "mode": Param(parse_str, "clip"),
+    },
+)
+
+
+def _batch_take(ins, params, mode):
+    data, indices = ins
+    return jnp.take_along_axis(
+        data, indices.astype(jnp.int32)[:, None], axis=1
+    )[:, 0]
+
+
+register("batch_take", _batch_take, arg_names=["a", "indices"])
+
+
+def _one_hot(ins, params, mode):
+    (indices,) = ins
+    d = params["depth"]
+    on, off = params["on_value"], params["off_value"]
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), d, dtype=np_dtype(params["dtype"]))
+    return oh * on + (1.0 - oh) * off
+
+
+register(
+    "one_hot",
+    _one_hot,
+    arg_names=["indices"],
+    param_schema={
+        "depth": Param(parse_int),
+        "on_value": Param(parse_float, 1.0),
+        "off_value": Param(parse_float, 0.0),
+        "dtype": Param(parse_str, "float32"),
+    },
+    infer_dtype=lambda ins, p: [np_dtype(ins[0] or "float32")],
+)
+
+
+def _pick(ins, params, mode):
+    data, index = ins
+    ax = params["axis"]
+    if ax is None:
+        ax = -1
+    idx = jnp.expand_dims(index.astype(jnp.int32), ax)
+    out = jnp.take_along_axis(data, idx, axis=ax)
+    if not params["keepdims"]:
+        out = jnp.squeeze(out, axis=ax)
+    return out
+
+
+register(
+    "pick",
+    _pick,
+    arg_names=["data", "index"],
+    param_schema={
+        "axis": Param(parse_int, -1),
+        "keepdims": Param(parse_bool, False),
+    },
+)
+
+
+def _embedding(ins, params, mode):
+    data, weight = ins
+    idx = jnp.clip(data.astype(jnp.int32), 0, params["input_dim"] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+register(
+    "Embedding",
+    _embedding,
+    arg_names=["data", "weight"],
+    param_schema={
+        "input_dim": Param(parse_int),
+        "output_dim": Param(parse_int),
+        "dtype": Param(parse_str, "float32"),
+    },
+    fill_in_shapes=lambda shapes, p: [
+        shapes[0],
+        shapes[1] or (p["input_dim"], p["output_dim"]),
+    ],
+)
+
+
+def _gather_nd(ins, params, mode):
+    data, indices = ins
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+register("gather_nd", _gather_nd, arg_names=["data", "indices"])
+
+
+# --- misc elementwise-with-params ------------------------------------------
+register(
+    "clip",
+    lambda ins, p, m: jnp.clip(ins[0], p["a_min"], p["a_max"]),
+    arg_names=["data"],
+    param_schema={"a_min": Param(parse_float), "a_max": Param(parse_float)},
+)
+
+
+def _repeat(ins, params, mode):
+    (x,) = ins
+    return jnp.repeat(x, params["repeats"], axis=params["axis"])
+
+
+register(
+    "repeat",
+    _repeat,
+    arg_names=["data"],
+    param_schema={"repeats": Param(parse_int), "axis": Param(parse_int, None)},
+)
+
+
+def _tile(ins, params, mode):
+    return jnp.tile(ins[0], params["reps"])
+
+
+register(
+    "tile",
+    _tile,
+    arg_names=["data"],
+    param_schema={"reps": Param(parse_shape)},
+)
+
+
+def _reverse(ins, params, mode):
+    (x,) = ins
+    out = x
+    for ax in params["axis"]:
+        out = jnp.flip(out, axis=ax)
+    return out
+
+
+register(
+    "reverse",
+    _reverse,
+    arg_names=["data"],
+    param_schema={"axis": Param(parse_shape)},
+    aliases=("flip",),
+)
+
+
+def _pad(ins, params, mode):
+    (x,) = ins
+    pw = params["pad_width"]
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    mode_ = params["mode"]
+    if mode_ == "constant":
+        return jnp.pad(x, pairs, constant_values=params["constant_value"])
+    if mode_ == "edge":
+        return jnp.pad(x, pairs, mode="edge")
+    if mode_ == "reflect":
+        return jnp.pad(x, pairs, mode="reflect")
+    raise MXNetError(f"Pad: unknown mode {mode_}")
+
+
+register(
+    "Pad",
+    _pad,
+    arg_names=["data"],
+    param_schema={
+        "pad_width": Param(parse_shape),
+        "mode": Param(parse_str, "constant"),
+        "constant_value": Param(parse_float, 0.0),
+    },
+    aliases=("pad",),
+)
+
+
+def _where(ins, params, mode):
+    cond, x, y = ins
+    if cond.shape != x.shape and cond.ndim == 1:
+        # MXNet allows 1-d condition selecting rows
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond != 0, x, y)
+
+
+register("where", _where, arg_names=["condition", "x", "y"])
+
+
+register(
+    "Cast",
+    lambda ins, p, m: ins[0].astype(np_dtype(p["dtype"])),
+    arg_names=["data"],
+    param_schema={"dtype": Param(parse_str)},
+    infer_dtype=lambda ins, p: [np_dtype(ins[0] or "float32")],
+    aliases=("cast",),
+)
+
+
+# --- gradient-control ops --------------------------------------------------
+register(
+    "BlockGrad",
+    lambda ins, p, m: jax.lax.stop_gradient(ins[0]),
+    arg_names=["data"],
+    aliases=("stop_gradient",),
+)
+
+register("identity", lambda ins, p, m: ins[0], arg_names=["data"], aliases=("_copy",))
+
+
+def _broadcast_to(ins, params, mode):
+    (x,) = ins
+    shape = tuple(
+        x.shape[i] if s == 0 else s for i, s in enumerate(params["shape"])
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+register(
+    "broadcast_to",
+    _broadcast_to,
+    arg_names=["data"],
+    param_schema={"shape": Param(parse_shape)},
+)
+
+
+def _broadcast_axis(ins, params, mode):
+    (x,) = ins
+    axes = params["axis"]
+    sizes = params["size"]
+    shape = list(x.shape)
+    for ax, s in zip(axes, sizes):
+        shape[ax] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+register(
+    "broadcast_axis",
+    _broadcast_axis,
+    arg_names=["data"],
+    param_schema={"axis": Param(parse_shape, ()), "size": Param(parse_shape, ())},
+    aliases=("broadcast_axes",),
+)
+
+register("zeros_like", lambda ins, p, m: jnp.zeros_like(ins[0]), arg_names=["data"])
+register("ones_like", lambda ins, p, m: jnp.ones_like(ins[0]), arg_names=["data"])
+
+
+# --- creation (no-input) ops ----------------------------------------------
+def _creation_schema():
+    return {
+        "shape": Param(parse_shape),
+        "dtype": Param(parse_str, "float32"),
+        "ctx": Param(parse_str, None),  # placement handled by caller
+    }
+
+
+register(
+    "_zeros",
+    lambda ins, p, m: jnp.zeros(p["shape"], np_dtype(p["dtype"])),
+    arg_names=[],
+    param_schema=_creation_schema(),
+    infer_dtype=lambda ins, p: [],
+)
+
+register(
+    "_ones",
+    lambda ins, p, m: jnp.ones(p["shape"], np_dtype(p["dtype"])),
+    arg_names=[],
+    param_schema=_creation_schema(),
+    infer_dtype=lambda ins, p: [],
+)
+
+
+def _full(ins, params, mode):
+    return jnp.full(params["shape"], params["value"], np_dtype(params["dtype"]))
+
+
+register(
+    "_full",
+    _full,
+    arg_names=[],
+    param_schema={**_creation_schema(), "value": Param(parse_float)},
+    infer_dtype=lambda ins, p: [],
+)
+
+
+def _arange(ins, params, mode):
+    start, stop, step = params["start"], params["stop"], params["step"]
+    if stop is None:
+        start, stop = 0.0, start
+    out = jnp.arange(start, stop, step, dtype=np_dtype(params["dtype"]))
+    if params["repeat"] > 1:
+        out = jnp.repeat(out, params["repeat"])
+    return out
+
+
+register(
+    "_arange",
+    _arange,
+    arg_names=[],
+    param_schema={
+        "start": Param(parse_float, 0.0),
+        "stop": Param(parse_float, None),
+        "step": Param(parse_float, 1.0),
+        "repeat": Param(parse_int, 1),
+        "dtype": Param(parse_str, "float32"),
+        "ctx": Param(parse_str, None),
+    },
+    infer_dtype=lambda ins, p: [],
+)

@@ -1,0 +1,290 @@
+"""Operator registry — the TPU-native analogue of the reference's NNVM op
+registry (``NNVM_REGISTER_OP`` + ``FCompute``/``FInferShape``/``FGradient``
+attrs, reference ``include/mxnet/op_attr_types.h:32-73``).
+
+Design
+------
+Each op is registered once with:
+
+* ``fn(inputs, params, mode) -> (outputs, new_aux)`` — a **pure jax
+  function**. This replaces both ``FCompute<cpu>`` and ``FCompute<gpu>``:
+  XLA compiles it for whatever backend the arrays live on, and because it is
+  pure jax, *gradients come for free* via jax autodiff — there is no
+  ``FGradient`` table. Ops with non-standard gradients (SoftmaxOutput,
+  MakeLoss, BlockGrad) encode them with ``jax.custom_vjp`` inside ``fn``.
+* ``param_schema`` — typed parameters with defaults, the analogue of
+  ``dmlc::Parameter`` structs; values parse from python natives *or* the
+  string form used in Symbol attributes / saved JSON.
+* ``fill_in_shapes(in_shapes, params)`` — optional completion of *unknown
+  input* shapes (e.g. FullyConnected's weight from data + num_hidden). The
+  reference writes a full bidirectional ``FInferShape`` per op; here output
+  shapes/dtypes are derived from ``jax.eval_shape`` on ``fn`` itself, so
+  inference can never disagree with execution, and only parameter-creating
+  layers need custom code.
+
+``mode`` carries execution-time state: ``is_train`` (static under jit) and a
+jax PRNG ``rng`` for stochastic ops (dropout, samplers). Under jit the rng is
+a traced input, making whole training steps reproducible from one seed.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+
+_REQUIRED = object()
+
+
+@dataclass(frozen=True)
+class OpMode:
+    """Execution-time context handed to every op ``fn``."""
+
+    is_train: bool = False
+    rng: object = None  # jax PRNG key, present iff opdef.need_rng
+
+
+class Param:
+    """One typed op parameter (analogue of a dmlc::Parameter field)."""
+
+    __slots__ = ("parse", "default", "doc")
+
+    def __init__(self, parse, default=_REQUIRED, doc=""):
+        self.parse = parse
+        self.default = default
+        self.doc = doc
+
+    @property
+    def required(self):
+        return self.default is _REQUIRED
+
+
+class OpDef:
+    """A registered operator."""
+
+    def __init__(
+        self,
+        name: str,
+        fn: Callable,
+        arg_names,
+        param_schema: Optional[dict] = None,
+        aux_names=None,
+        fill_in_shapes: Optional[Callable] = None,
+        infer_dtype: Optional[Callable] = None,
+        num_outputs=1,
+        num_visible_outputs=None,
+        need_rng: bool = False,
+        aliases: Sequence[str] = (),
+        mutate: Sequence = (),
+        doc: str = "",
+    ):
+        self.name = name
+        self.fn = fn
+        self._arg_names = arg_names
+        self.param_schema = param_schema or {}
+        self._aux_names = aux_names or []
+        self.fill_in_shapes = fill_in_shapes
+        self._infer_dtype = infer_dtype
+        self._num_outputs = num_outputs
+        self._num_visible_outputs = num_visible_outputs
+        self.need_rng = need_rng
+        self.aliases = tuple(aliases)
+        # mutate: [(input_name, hidden_output_index)] — imperative calls
+        # rebind these input handles to the given outputs (the analogue of
+        # the reference's mutable-input declaration on optimizer ops).
+        self.mutate = tuple(mutate)
+        self.doc = doc
+
+    # --- introspection ---------------------------------------------------
+    def arg_names(self, params) -> list:
+        if callable(self._arg_names):
+            return list(self._arg_names(params))
+        return list(self._arg_names)
+
+    def aux_names(self, params) -> list:
+        if callable(self._aux_names):
+            return list(self._aux_names(params))
+        return list(self._aux_names)
+
+    def num_outputs(self, params) -> int:
+        if callable(self._num_outputs):
+            return int(self._num_outputs(params))
+        return int(self._num_outputs)
+
+    def num_visible_outputs(self, params) -> int:
+        if self._num_visible_outputs is None:
+            return self.num_outputs(params)
+        if callable(self._num_visible_outputs):
+            return int(self._num_visible_outputs(params))
+        return int(self._num_visible_outputs)
+
+    # --- params ----------------------------------------------------------
+    def parse_params(self, raw: dict) -> dict:
+        """Parse raw attrs (python values or strings) into typed params.
+
+        Attribute keys wrapped in double underscores (``__ctx_group__`` etc.)
+        are Symbol-level metadata, not op params, and are skipped. Unknown
+        keys raise, mirroring dmlc::Parameter strictness.
+        """
+        out = {}
+        for k, spec in self.param_schema.items():
+            if k in raw and raw[k] is not None:
+                try:
+                    out[k] = spec.parse(raw[k])
+                except (ValueError, SyntaxError) as e:
+                    raise MXNetError(
+                        f"op {self.name}: cannot parse param {k}={raw[k]!r}"
+                    ) from e
+            elif spec.required:
+                raise MXNetError(f"op {self.name}: missing required param {k}")
+            else:
+                out[k] = spec.default
+        for k in raw:
+            if k not in self.param_schema and not (
+                k.startswith("__") and k.endswith("__")
+            ):
+                raise MXNetError(f"op {self.name}: unknown param {k!r}")
+        return out
+
+    # --- execution -------------------------------------------------------
+    def apply(self, inputs, params, mode: OpMode):
+        """Run ``fn``; normalise the result to ``(outputs, new_aux)`` lists."""
+        res = self.fn(list(inputs), params, mode)
+        if isinstance(res, tuple) and len(res) == 2 and isinstance(res[0], list):
+            outputs, new_aux = res
+        elif isinstance(res, (list, tuple)):
+            outputs, new_aux = list(res), []
+        else:
+            outputs, new_aux = [res], []
+        return outputs, new_aux
+
+    # --- inference -------------------------------------------------------
+    def infer_shape(self, in_shapes, params, in_dtypes=None):
+        """Return (completed_in_shapes, out_shapes, aux_shapes).
+
+        ``in_shapes`` covers args then aux, entries may be None (unknown).
+        """
+        import jax
+
+        names = self.arg_names(params) + self.aux_names(params)
+        if len(in_shapes) != len(names):
+            raise MXNetError(
+                f"op {self.name}: expected {len(names)} inputs "
+                f"({names}), got {len(in_shapes)} shapes"
+            )
+        shapes = list(in_shapes)
+        if self.fill_in_shapes is not None:
+            shapes = list(self.fill_in_shapes(shapes, params))
+        if any(s is None for s in shapes):
+            missing = [n for n, s in zip(names, shapes) if s is None]
+            raise MXNetError(
+                f"op {self.name}: cannot infer shapes of inputs {missing}"
+            )
+        if in_dtypes is None:
+            in_dtypes = [None] * len(shapes)
+        dtypes = self._complete_dtypes(in_dtypes, params)
+        structs = [
+            jax.ShapeDtypeStruct(tuple(s), np_dtype(d))
+            for s, d in zip(shapes, dtypes)
+        ]
+        mode = OpMode(is_train=True, rng=_dummy_key_struct() if self.need_rng else None)
+        try:
+            outs, new_aux = jax.eval_shape(
+                lambda ins: self.apply(ins, params, mode), structs
+            )
+        except Exception as e:
+            raise MXNetError(
+                f"op {self.name}: shape inference failed for inputs "
+                f"{list(zip(names, shapes))}: {e}"
+            ) from e
+        n_aux = len(self.aux_names(params))
+        n_args = len(self.arg_names(params))
+        arg_shapes = [tuple(s) for s in shapes[:n_args]]
+        aux_shapes = [tuple(s) for s in shapes[n_args:]]
+        out_shapes = [tuple(o.shape) for o in outs]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_dtype(self, in_dtypes, params):
+        import jax
+
+        names = self.arg_names(params) + self.aux_names(params)
+        dtypes = self._complete_dtypes(list(in_dtypes), params)
+        # Outputs via eval_shape on rank-consistent dummy shapes is not
+        # possible without shapes; use scalar-broadcastable probe shapes.
+        probe = [(1,) * 0 for _ in names]
+        mode = OpMode(is_train=True, rng=_dummy_key_struct() if self.need_rng else None)
+        try:
+            structs = [
+                jax.ShapeDtypeStruct((), np_dtype(d)) for d in dtypes
+            ]
+            outs, _ = jax.eval_shape(
+                lambda ins: self.apply(ins, params, mode), structs
+            )
+            out_dtypes = [np_dtype(o.dtype) for o in outs]
+        except Exception:
+            out_dtypes = [np_dtype(dtypes[0] if dtypes else "float32")] * self.num_outputs(params)
+        n_args = len(self.arg_names(params))
+        return dtypes[:n_args], out_dtypes, dtypes[n_args:]
+
+    def _complete_dtypes(self, in_dtypes, params):
+        if self._infer_dtype is not None:
+            return [np_dtype(d) for d in self._infer_dtype(in_dtypes, params)]
+        known = next((d for d in in_dtypes if d is not None), "float32")
+        return [np_dtype(d if d is not None else known) for d in in_dtypes]
+
+
+def _dummy_key_struct():
+    import jax
+
+    return jax.ShapeDtypeStruct((2,), np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+_OPS: dict = {}
+
+
+def register(name, fn=None, **kwargs):
+    """Register an op. Usable directly or as a decorator."""
+
+    def _do(f):
+        opdef = OpDef(name, f, **kwargs)
+        if name in _OPS:
+            raise MXNetError(f"op {name} registered twice")
+        _OPS[name] = opdef
+        for alias in opdef.aliases:
+            _OPS[alias] = opdef
+        return f
+
+    if fn is not None:
+        return _do(fn)
+    return _do
+
+
+def get(name: str) -> OpDef:
+    op = _OPS.get(name)
+    if op is None:
+        raise MXNetError(f"unknown operator {name!r}")
+    return op
+
+
+def exists(name: str) -> bool:
+    return name in _OPS
+
+
+def list_ops():
+    return sorted(_OPS.keys())
+
+
+def canonical_ops():
+    """Unique OpDefs (aliases collapsed), keyed by canonical name."""
+    seen = {}
+    for name, op in _OPS.items():
+        if op.name == name:
+            seen[name] = op
+    return seen
