@@ -1,0 +1,81 @@
+"""Benchmark: ResNet-50 ImageNet training throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's published ResNet-50 train throughput on its best
+single GPU (P100, 181.53 img/s @ bs32, docs/how_to/perf.md:179-188 — see
+BASELINE.md). Methodology mirrors ``train_imagenet.py --benchmark 1``:
+synthetic data, train-mode forward+backward+update, steady-state timing.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    batch_size = int(os.environ.get("BENCH_BATCH", 64 if on_tpu else 8))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16" if on_tpu else "float32")
+    warmup = 3
+    iters = int(os.environ.get("BENCH_ITERS", 20 if on_tpu else 3))
+    num_layers = int(os.environ.get("BENCH_LAYERS", 50))
+    image = (3, 224, 224) if on_tpu else (3, 64, 64)
+
+    sym = models.resnet(
+        num_classes=1000, num_layers=num_layers,
+        image_shape=",".join(map(str, image)),
+    )
+    ctx = mx.gpu() if on_tpu else mx.cpu()
+    mod = mx.mod.Module(sym, context=ctx)
+    mod.bind(
+        data_shapes=[mx.io.DataDesc("data", (batch_size,) + image, dtype)],
+        label_shapes=[mx.io.DataDesc("softmax_label", (batch_size,))],
+    )
+    mod.init_params(initializer=mx.init.Xavier(rnd_type="gaussian",
+                                               factor_type="in", magnitude=2))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01, "momentum": 0.9})
+
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(
+        rng.uniform(-1, 1, (batch_size,) + image).astype(np.float32), dtype=dtype
+    )
+    label = mx.nd.array(rng.randint(0, 1000, (batch_size,)).astype(np.float32))
+    batch = mx.io.DataBatch(data=[data], label=[label])
+
+    def step():
+        mod.forward_backward(batch)
+        mod.update()
+
+    for _ in range(warmup):
+        step()
+    mx.nd.waitall()
+
+    tic = time.time()
+    for _ in range(iters):
+        step()
+    mod.get_outputs()[0].wait_to_read()
+    mx.nd.waitall()
+    elapsed = time.time() - tic
+
+    img_per_sec = batch_size * iters / elapsed
+    baseline = 181.53  # reference P100 ResNet-50 train img/s @bs32
+    print(json.dumps({
+        "metric": f"resnet{num_layers}_train_throughput"
+                  + ("" if on_tpu else "_cpusmoke"),
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

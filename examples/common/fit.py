@@ -1,0 +1,186 @@
+"""Shared training-CLI plumbing.
+
+Reference: ``example/image-classification/common/fit.py`` — argparse flags
+(--network --num-layers --gpus --kv-store --lr --lr-factor --lr-step-epochs
+--optimizer --mom --wd --batch-size --disp-batches --model-prefix
+--load-epoch --top-k --benchmark 1 synthetic mode) and the fit() driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_fit_args(parser):
+    train = parser.add_argument_group("Training", "model training")
+    train.add_argument("--network", type=str, default="resnet")
+    train.add_argument("--num-layers", type=int, default=50)
+    train.add_argument("--gpus", type=str, default=None,
+                       help="comma-separated device ids (TPU chips on a TPU host)")
+    train.add_argument("--kv-store", type=str, default="device")
+    train.add_argument("--num-epochs", type=int, default=100)
+    train.add_argument("--lr", type=float, default=0.1)
+    train.add_argument("--lr-factor", type=float, default=0.1)
+    train.add_argument("--lr-step-epochs", type=str, default="30,60")
+    train.add_argument("--optimizer", type=str, default="sgd")
+    train.add_argument("--mom", type=float, default=0.9)
+    train.add_argument("--wd", type=float, default=0.0001)
+    train.add_argument("--batch-size", type=int, default=128)
+    train.add_argument("--disp-batches", type=int, default=20)
+    train.add_argument("--model-prefix", type=str, default=None)
+    train.add_argument("--load-epoch", type=int, default=None)
+    train.add_argument("--top-k", type=int, default=0)
+    train.add_argument("--dtype", type=str, default="float32")
+    train.add_argument("--benchmark", type=int, default=0,
+                       help="1 = train with synthetic data (reference --benchmark)")
+    train.add_argument("--num-examples", type=int, default=1281167)
+    train.add_argument("--num-classes", type=int, default=1000)
+    train.add_argument("--image-shape", type=str, default="3,224,224")
+    return train
+
+
+def _get_contexts(args):
+    if args.gpus is None or args.gpus == "":
+        n = mx.num_gpus()
+        if n == 0:
+            return [mx.cpu()]
+        return [mx.gpu(i) for i in range(n)]
+    return [mx.gpu(int(i)) for i in args.gpus.split(",")]
+
+
+def _get_lr_scheduler(args, kv):
+    if args.lr_factor is None or args.lr_factor >= 1:
+        return (args.lr, None)
+    epoch_size = args.num_examples // args.batch_size
+    begin_epoch = args.load_epoch or 0
+    step_epochs = [int(l) for l in args.lr_step_epochs.split(",")]
+    lr = args.lr
+    for s in step_epochs:
+        if begin_epoch >= s:
+            lr *= args.lr_factor
+    steps = [
+        epoch_size * (x - begin_epoch) for x in step_epochs
+        if x - begin_epoch > 0
+    ]
+    if not steps:
+        return (lr, None)
+    return (lr, mx.lr_scheduler.MultiFactorScheduler(step=steps, factor=args.lr_factor))
+
+
+class SyntheticDataIter(mx.io.DataIter):
+    """Synthetic data (reference --benchmark 1, README.md:246-258)."""
+
+    def __init__(self, num_classes, data_shape, max_iter, dtype="float32"):
+        self.batch_size = data_shape[0]
+        self.cur_iter = 0
+        self.max_iter = max_iter
+        self.dtype = dtype
+        label = np.random.randint(0, num_classes, [self.batch_size])
+        data = np.random.uniform(-1, 1, data_shape).astype(np.float32)
+        self.data = mx.nd.array(data, dtype=dtype)
+        self.label = mx.nd.array(label.astype(np.float32))
+        self.provide_data = [mx.io.DataDesc("data", data_shape, dtype)]
+        self.provide_label = [mx.io.DataDesc("softmax_label", (self.batch_size,))]
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        self.cur_iter += 1
+        if self.cur_iter <= self.max_iter:
+            return mx.io.DataBatch(
+                data=[self.data], label=[self.label], pad=0, index=None,
+                provide_data=self.provide_data,
+                provide_label=self.provide_label,
+            )
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def reset(self):
+        self.cur_iter = 0
+
+
+def fit(args, network, data_loader, **kwargs):
+    """Train the network (reference common/fit.py fit())."""
+    kv = mx.kv.create(args.kv_store) if args.kv_store else None
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)-15s Node[0] %(message)s"
+    )
+    logging.info("start with arguments %s", args)
+
+    if args.benchmark:
+        data_shape = (args.batch_size,) + tuple(
+            int(x) for x in args.image_shape.split(",")
+        )
+        train = SyntheticDataIter(
+            args.num_classes, data_shape,
+            args.num_examples // args.batch_size, args.dtype,
+        )
+        val = None
+    else:
+        (train, val) = data_loader(args, kv)
+
+    devs = _get_contexts(args)
+    lr, lr_scheduler = _get_lr_scheduler(args, kv)
+
+    model = mx.mod.Module(context=devs, symbol=network)
+
+    optimizer_params = {
+        "learning_rate": lr,
+        "wd": args.wd,
+        "lr_scheduler": lr_scheduler,
+    }
+    if args.optimizer in ("sgd", "nag", "dcasgd"):
+        optimizer_params["momentum"] = args.mom
+
+    initializer = mx.init.Xavier(
+        rnd_type="gaussian", factor_type="in", magnitude=2
+    )
+
+    arg_params, aux_params = None, None
+    if args.load_epoch is not None and args.model_prefix:
+        _sym, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch
+        )
+
+    checkpoint = (
+        mx.callback.do_checkpoint(args.model_prefix)
+        if args.model_prefix else None
+    )
+    batch_end_callbacks = [
+        mx.callback.Speedometer(args.batch_size, args.disp_batches)
+    ]
+
+    eval_metrics = ["accuracy"]
+    if args.top_k > 0:
+        eval_metrics.append(
+            mx.metric.create("top_k_accuracy", top_k=args.top_k)
+        )
+
+    model.fit(
+        train,
+        begin_epoch=args.load_epoch if args.load_epoch else 0,
+        num_epoch=args.num_epochs,
+        eval_data=val,
+        eval_metric=eval_metrics,
+        kvstore=kv,
+        optimizer=args.optimizer,
+        optimizer_params=optimizer_params,
+        initializer=initializer,
+        arg_params=arg_params,
+        aux_params=aux_params,
+        batch_end_callback=batch_end_callbacks,
+        epoch_end_callback=checkpoint,
+        allow_missing=True,
+    )
+    return model

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Train ImageNet classifiers (reference
+example/image-classification/train_imagenet.py): resnet/vgg/inception-bn
+over RecordIO shards or --benchmark 1 synthetic data.
+
+Canonical benchmark (the BASELINE.json north star):
+    python train_imagenet.py --network resnet --num-layers 50 \
+        --kv-store device --benchmark 1 --batch-size 64 --dtype bfloat16
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+sys.path.insert(0, os.path.dirname(__file__))
+from common.fit import add_fit_args, fit
+
+
+def get_imagenet_iter(args, kv):
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train,
+        data_shape=tuple(int(x) for x in args.image_shape.split(",")),
+        batch_size=args.batch_size,
+        shuffle=True, rand_crop=True, rand_mirror=True, resize=256,
+        mean_r=123.68, mean_g=116.78, mean_b=103.94,
+        num_parts=kv.num_workers if kv else 1,
+        part_index=kv.rank if kv else 0,
+        preprocess_threads=args.data_nthreads,
+    )
+    val = None
+    if args.data_val:
+        val = mx.io.ImageRecordIter(
+            path_imgrec=args.data_val,
+            data_shape=tuple(int(x) for x in args.image_shape.split(",")),
+            batch_size=args.batch_size, resize=256,
+            mean_r=123.68, mean_g=116.78, mean_b=103.94,
+            preprocess_threads=args.data_nthreads,
+        )
+    return train, val
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="train imagenet")
+    parser.add_argument("--data-train", type=str, default="data/train.rec")
+    parser.add_argument("--data-val", type=str, default=None)
+    parser.add_argument("--data-nthreads", type=int, default=8)
+    add_fit_args(parser)
+    parser.set_defaults(
+        network="resnet", num_layers=50, batch_size=128, num_epochs=90,
+        lr=0.1, lr_step_epochs="30,60,80",
+    )
+    args = parser.parse_args()
+
+    builders = {
+        "resnet": lambda: models.resnet(
+            num_classes=args.num_classes, num_layers=args.num_layers,
+            image_shape=args.image_shape,
+        ),
+        "vgg": lambda: models.vgg(
+            num_classes=args.num_classes, num_layers=args.num_layers or 16
+        ),
+        "inception-bn": lambda: models.inception_bn(num_classes=args.num_classes),
+        "mlp": lambda: models.mlp(num_classes=args.num_classes),
+        "lenet": lambda: models.lenet(num_classes=args.num_classes),
+    }
+    net = builders[args.network]()
+    fit(args, net, get_imagenet_iter)

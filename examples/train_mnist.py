@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Train MLP/LeNet on MNIST (reference
+example/image-classification/train_mnist.py). With no MNIST files present,
+--synthetic 1 trains on generated digit-like data so the script runs
+anywhere (the reference downloads MNIST; this environment has no egress).
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+sys.path.insert(0, os.path.dirname(__file__))
+from common.fit import add_fit_args, fit
+
+
+def get_mnist_iter(args, kv):
+    if args.synthetic or not os.path.exists(
+        os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    ):
+        rs = np.random.RandomState(0)
+        n = 6000
+        # blobby synthetic digits: class k = gaussian bump at position k
+        Y = rs.randint(0, 10, n)
+        X = rs.rand(n, 1, 28, 28).astype(np.float32) * 0.1
+        for i in range(n):
+            cx, cy = 4 + 2 * (Y[i] % 5), 8 + 12 * (Y[i] // 5)
+            X[i, 0, cy:cy + 8, cx:cx + 8] += 0.9
+        if args.flat:
+            X = X.reshape(n, 784)
+        split = int(n * 0.9)
+        train = mx.io.NDArrayIter(
+            X[:split], Y[:split].astype(np.float32), args.batch_size,
+            shuffle=True,
+        )
+        val = mx.io.NDArrayIter(
+            X[split:], Y[split:].astype(np.float32), args.batch_size
+        )
+        return train, val
+    train = mx.io.MNISTIter(
+        image=os.path.join(args.data_dir, "train-images-idx3-ubyte"),
+        label=os.path.join(args.data_dir, "train-labels-idx1-ubyte"),
+        batch_size=args.batch_size, shuffle=True, flat=args.flat,
+        num_parts=kv.num_workers if kv else 1,
+        part_index=kv.rank if kv else 0,
+    )
+    val = mx.io.MNISTIter(
+        image=os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
+        label=os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+        batch_size=args.batch_size, flat=args.flat,
+    )
+    return train, val
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="train mnist")
+    parser.add_argument("--data-dir", type=str, default="data/mnist/")
+    parser.add_argument("--synthetic", type=int, default=0)
+    add_fit_args(parser)
+    parser.set_defaults(
+        network="mlp", num_layers=0, batch_size=64, num_epochs=10, lr=0.05,
+        lr_step_epochs="10", kv_store="local", num_classes=10,
+        num_examples=60000, image_shape="1,28,28",
+    )
+    args = parser.parse_args()
+    args.flat = args.network == "mlp"
+
+    if args.network == "mlp":
+        net = models.mlp(num_classes=args.num_classes)
+    else:
+        net = models.lenet(num_classes=args.num_classes)
+
+    fit(args, net, get_mnist_iter)

@@ -1,10 +1,23 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the capabilities of
+Apache MXNet 0.10 (NNVM era), re-designed for jax/XLA/Pallas.
+
+Import as ``import mxnet_tpu as mx``; the namespace mirrors the reference's
+``python/mxnet`` package: ``mx.nd``, ``mx.sym``, ``mx.mod``, ``mx.io``,
+``mx.kv``, ``mx.metric``, ``mx.optimizer``, ``mx.init``, ``mx.rnn``, etc.
+"""
+
 from .base import MXNetError, __version__
-from .context import Context, cpu, gpu, tpu, current_context, num_gpus
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
+
 from . import ndarray
 from . import ndarray as nd
 from . import random
+from . import random as rnd
 from . import autograd
+
 from .ndarray import NDArray
+
+# populated by later build stages; import lazily where heavy
 from . import symbol
 from . import symbol as sym
 from .symbol import Symbol, Variable
@@ -14,3 +27,29 @@ from . import attribute
 from .attribute import AttrScope
 from . import name
 from .name import NameManager
+
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import optimizer as opt
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import io
+from . import recordio
+from . import kvstore
+from . import kvstore as kv
+from . import callback
+from . import monitor
+from . import model
+from .model import FeedForward
+from . import module
+from . import module as mod
+from . import rnn
+from . import image
+from . import profiler
+from . import visualization
+from . import visualization as viz
+from . import test_utils
+from . import contrib
+from . import parallel

@@ -35,6 +35,9 @@ class TapeEntry:
     inputs: list
     outputs: list
     rng: object = None
+    # values of inputs AT RECORD TIME — replay must not read a handle's
+    # current (possibly later-mutated) data for inputs outside the env
+    input_values: list = field(default_factory=list)
 
 
 def is_recording():
@@ -115,7 +118,12 @@ def mark_variables(variables, gradients=None, grad_reqs="write"):
 def record_op(opdef, params, inputs, outputs, rng=None):
     st = _st()
     if st.recording:
-        st.tape.append(TapeEntry(opdef, params, list(inputs), list(outputs), rng))
+        st.tape.append(
+            TapeEntry(
+                opdef, params, list(inputs), list(outputs), rng,
+                [nd._data for nd in inputs],
+            )
+        )
 
 
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
@@ -142,8 +150,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             env[id(nd)] = v
         for entry in tape:
             ins = []
-            for nd in entry.inputs:
-                ins.append(env.get(id(nd), nd._data))
+            for nd, recorded in zip(entry.inputs, entry.input_values):
+                ins.append(env.get(id(nd), recorded))
             mode = OpMode(is_train=train_mode, rng=entry.rng)
             outs, _aux = entry.opdef.apply(ins, entry.params, mode)
             for nd, o in zip(entry.outputs, outs):
@@ -189,7 +197,10 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     def replay(leaf_vals):
         env = {id(nd): v for nd, v in zip(var_list, leaf_vals)}
         for entry in tape:
-            ins = [env.get(id(nd), nd._data) for nd in entry.inputs]
+            ins = [
+                env.get(id(nd), rec)
+                for nd, rec in zip(entry.inputs, entry.input_values)
+            ]
             mode = OpMode(is_train=train_mode, rng=entry.rng)
             outs, _aux = entry.opdef.apply(ins, entry.params, mode)
             for nd, o in zip(entry.outputs, outs):

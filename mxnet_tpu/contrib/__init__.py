@@ -1,0 +1,3 @@
+"""Contrib namespace (reference ``python/mxnet/contrib``/``src/operator/contrib``)."""
+
+from .. import autograd  # reference exposed mx.contrib.autograd

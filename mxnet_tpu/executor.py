@@ -303,25 +303,37 @@ class Executor:
         self._pending = "train" if is_train else "eval"
         self._fresh = False
         self._step += 1
+        # Snapshot ALL input values at call time: the lazy materialisation
+        # and a later fused forward+backward compute from this base, so (a)
+        # mutating a bound arg after forward() doesn't change the scheduled
+        # result (engine read-ordering semantics, threaded_engine.h:93-195)
+        # and (b) BatchNorm moving stats update exactly once per forward().
+        self._args_in = self._arg_vals()
+        self._aux_in = self._aux_vals()
         if self._monitor_callback is not None:
             self._materialize_forward()
-        return self.outputs
+        else:
+            for h in self._output_handles:
+                h._set_lazy(self._materialize_forward)
+        return list(self._output_handles)
 
     def _materialize_forward(self):
         if self._pending is None:
             return
         is_train = self._pending == "train"
+        args_in = getattr(self, "_args_in", None) or self._arg_vals()
+        aux_in = getattr(self, "_aux_in", None) or self._aux_vals()
         if self._monitor_callback is not None:
             outs, aux_upd = self.graph.evaluate(
-                self._arg_vals(),
-                self._aux_vals(),
+                args_in,
+                aux_in,
                 self._rng_key(),
                 is_train,
                 monitor=self._monitor_callback,
             )
         else:
             fn = self._get_jit("forward", is_train=is_train)
-            outs, aux_upd = fn(self._arg_vals(), self._aux_vals(), self._rng_key())
+            outs, aux_upd = fn(args_in, aux_in, self._rng_key())
         self._set_outputs(outs)
         self._set_aux(aux_upd)
         self._pending = None
@@ -332,13 +344,20 @@ class Executor:
             h._data = o
 
     def _set_aux(self, aux_upd):
-        for n, v in zip(self.aux_names, aux_upd):
-            self.aux_dict[n]._data = v
+        snap = getattr(self, "_aux_in", None)
+        for i, (n, v) in enumerate(zip(self.aux_names, aux_upd)):
+            handle = self.aux_dict[n]
+            # last-write-wins: if someone wrote to this aux between forward()
+            # and materialisation (e.g. copy_params_from), keep their value —
+            # the reference engine would order that write after the forward.
+            if snap is not None and handle._d is not snap[i]:
+                continue
+            handle._data = v
 
     @property
     def outputs(self):
-        self._materialize_forward()
-        if not self._fresh and self._output_handles and self._output_handles[0]._data is None:
+        if self._pending is None and not self._fresh and \
+                self._output_handles and self._output_handles[0]._d is None:
             raise MXNetError("outputs accessed before any forward call")
         return list(self._output_handles)
 
@@ -360,8 +379,10 @@ class Executor:
             for n in self._wrt_names
             if self.grad_req[n] == "add"
         }
+        args_in = getattr(self, "_args_in", None) or self._arg_vals()
+        aux_in = getattr(self, "_aux_in", None) or self._aux_vals()
         outs, aux_upd, grad_map = fn(
-            self._arg_vals(), self._aux_vals(), self._rng_key(), head_grads, prev
+            args_in, aux_in, self._rng_key(), head_grads, prev
         )
         self._set_outputs(outs)
         self._set_aux(aux_upd)

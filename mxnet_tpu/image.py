@@ -1,0 +1,311 @@
+"""Pure-python image IO + augmenters.
+
+Reference: ``python/mxnet/image.py`` (724 LoC) — ``imdecode``, resize/crop
+helpers, augmenter list factory ``CreateAugmenter`` and the python
+``ImageIter``. Decoding uses OpenCV exactly like the reference's
+``src/io/image_io.cc`` path; arrays come back as NDArray (HWC, uint8/float).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer (reference image.imdecode)."""
+    import cv2
+
+    img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8),
+                       cv2.IMREAD_COLOR if flag else cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("Decoding failed; invalid image data")
+    if to_rgb and flag:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    res = array(img.astype(np.uint8), dtype=np.uint8)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def scale_down(src_size, size):
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge is ``size`` (reference resize_short)."""
+    import cv2
+
+    img = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    out = cv2.resize(img, (new_w, new_h), interpolation=interp)
+    return array(out.astype(img.dtype), dtype=img.dtype)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    import cv2
+
+    img = src.asnumpy() if isinstance(src, NDArray) else src
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = cv2.resize(out, size, interpolation=interp)
+    return array(out.astype(img.dtype), dtype=img.dtype)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = random.randint(0, w - new_w)
+    y0 = random.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype("float32") if isinstance(src, NDArray) else array(src.astype(np.float32))
+    out = src - mean
+    if std is not None:
+        out = out / std
+    return out
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    h, w = src.shape[:2]
+    area = w * h
+    for _ in range(10):
+        new_area = random.uniform(min_area, 1.0) * area
+        new_ratio = random.uniform(*ratio)
+        new_w = int(np.sqrt(new_area * new_ratio))
+        new_h = int(np.sqrt(new_area / new_ratio))
+        if random.random() < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w <= w and new_h <= h:
+            x0 = random.randint(0, w - new_w)
+            y0 = random.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def ResizeAug(size, interp=2):
+    def aug(src):
+        return [resize_short(src, size, interp)]
+
+    return aug
+
+
+def RandomCropAug(size, interp=2):
+    def aug(src):
+        return [random_crop(src, size, interp)[0]]
+
+    return aug
+
+
+def RandomSizedCropAug(size, min_area, ratio, interp=2):
+    def aug(src):
+        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
+
+    return aug
+
+
+def CenterCropAug(size, interp=2):
+    def aug(src):
+        return [center_crop(src, size, interp)[0]]
+
+    return aug
+
+
+def HorizontalFlipAug(p):
+    def aug(src):
+        if random.random() < p:
+            img = src.asnumpy()[:, ::-1]
+            return [array(img, dtype=img.dtype)]
+        return [src]
+
+    return aug
+
+
+def CastAug():
+    def aug(src):
+        return [src.astype("float32")]
+
+    return aug
+
+
+def ColorNormalizeAug(mean, std):
+    mean_nd = np.asarray(mean, dtype=np.float32)
+    std_nd = np.asarray(std, dtype=np.float32) if std is not None else None
+
+    def aug(src):
+        return [color_normalize(src, mean_nd, std_nd)]
+
+    return aug
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Create the standard augmenter list (reference CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0, 4.0 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and np.ndim(mean):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Pure-python image iterator over .lst/.rec or raw files
+    (reference image.ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
+                 path_imglist=None, path_root=None, shuffle=False,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
+        self.shuffle = shuffle
+
+        self.imgrec = None
+        self.imglist = {}
+        self.seq = []
+        if path_imgrec:
+            from .recordio import MXIndexedRecordIO
+
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.imgrec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self.seq = list(self.imgrec.keys)
+        if path_imglist:
+            with open(path_imglist) as fin:
+                for line in fin:
+                    line = line.strip().split("\t")
+                    label = np.array([float(i) for i in line[1:-1]], dtype=np.float32)
+                    key = int(line[0])
+                    self.imglist[key] = (label, line[-1])
+                    self.seq.append(key)
+        elif isinstance(imglist, list):
+            for i, item in enumerate(imglist):
+                key = i
+                label = np.array(item[0], dtype=np.float32) if np.ndim(item[0]) \
+                    else np.array([item[0]], dtype=np.float32)
+                self.imglist[key] = (label, item[1])
+                self.seq.append(key)
+        self.path_root = path_root
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        from .io import DataDesc
+
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        from .io import DataDesc
+
+        shape = (self.batch_size,) if self.label_width == 1 else (
+            self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        if self.shuffle:
+            random.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is not None:
+            from .recordio import unpack
+
+            s = self.imgrec.read_idx(idx)
+            header, img = unpack(s)
+            if idx in self.imglist:
+                return self.imglist[idx][0], img
+            return header.label, img
+        label, fname = self.imglist[idx]
+        with open(os.path.join(self.path_root or "", fname), "rb") as fin:
+            img = fin.read()
+        return label, img
+
+    def next(self):
+        from .io import DataBatch
+
+        batch_data = np.zeros((self.batch_size,) + self.data_shape, dtype=np.float32)
+        batch_label = np.zeros((self.batch_size, self.label_width), dtype=np.float32)
+        i = 0
+        while i < self.batch_size:
+            label, s = self.next_sample()
+            data = [imdecode(s)]
+            for aug in self.auglist:
+                data = [ret for src in data for ret in aug(src)]
+            for d in data:
+                assert i < self.batch_size, "Batch size must be multiple of augmenter output length"
+                batch_data[i] = d.asnumpy().transpose(2, 0, 1)
+                batch_label[i] = label
+                i += 1
+        label_out = batch_label[:, 0] if self.label_width == 1 else batch_label
+        return DataBatch(
+            data=[array(batch_data)], label=[array(label_out)], pad=0,
+            index=None, provide_data=self.provide_data,
+            provide_label=self.provide_label,
+        )
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()

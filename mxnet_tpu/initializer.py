@@ -1,0 +1,306 @@
+"""Weight initializers.
+
+Reference: ``python/mxnet/initializer.py`` (660 LoC, ``initializer.py:15-546``)
+— registry + ``Zero/One/Constant/Uniform/Normal/Orthogonal/Xavier/MSRAPrelu``,
+``Load``, ``Mixed`` and ``InitDesc`` attr-aware dispatch. Name-pattern rules
+(``*_weight`` → weight init, ``*_bias``/``*_gamma`` etc. → defaults) are kept
+identical since Module.init_params and the RNN toolkit rely on them.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+from . import random as _random
+from . import registry as _registry_mod  # noqa: F401  (parity placeholder)
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class InitDesc(str):
+    """Name + attrs descriptor handed to initializers (reference InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer with the reference's name-pattern dispatch."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("desc must be a string or InitDesc")
+        if not isinstance(arr, NDArray):
+            raise TypeError("arr must be NDArray")
+        attrs = getattr(desc, "attrs", {})
+        if attrs.get("__init__"):
+            klass, kwargs = json.loads(attrs["__init__"])
+            _INIT_REGISTRY[klass.lower()](**kwargs)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("upsampling"):
+            self._init_bilinear(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("moving_mean") or name.endswith("running_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_var") or name.endswith("running_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_inv_var"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # --- default rules ----------------------------------------------------
+    def _init_bilinear(self, _, arr):
+        weight = np.zeros(arr.shape, dtype="float32")
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = array(weight.reshape(shape))
+
+    def _init_zero(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("Must override _init_weight")
+
+    def _init_default(self, name, arr):
+        raise ValueError(
+            f"Unknown initialization pattern for {name}. Default initialization "
+            "is now limited to _weight/_bias/_gamma/_beta/moving_* suffixes; "
+            "use mx.sym.Variable(init=...) to set initialization explicitly."
+        )
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        import jax
+
+        arr[:] = NDArray(
+            jax.random.uniform(
+                _random.next_key(), arr.shape, minval=-self.scale,
+                maxval=self.scale, dtype="float32",
+            ).astype(arr.dtype)
+        )
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        import jax
+
+        arr[:] = NDArray(
+            (jax.random.normal(_random.next_key(), arr.shape, dtype="float32")
+             * self.sigma).astype(arr.dtype)
+        )
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        rs = np.random.RandomState(int(np.asarray(
+            _random.next_key(), dtype=np.uint32).sum()) % (2**31))
+        if self.rand_type == "uniform":
+            tmp = rs.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = rs.normal(0.0, 1.0, (nout, nin))
+        u, _v, q = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else q
+        arr[:] = array((self.scale * q).reshape(arr.shape).astype("float32"))
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(
+            rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude
+        )
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        import jax
+
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(
+                f"Xavier initializer cannot be applied to vector {name}"
+            )
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.0
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            data = jax.random.uniform(
+                _random.next_key(), shape, minval=-scale, maxval=scale,
+                dtype="float32",
+            )
+        elif self.rnd_type == "gaussian":
+            data = jax.random.normal(_random.next_key(), shape, dtype="float32") * scale
+        else:
+            raise ValueError("Unknown random type")
+        arr[:] = NDArray(data.astype(arr.dtype))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Load:
+    """Init from a dict of arrays, falling back to ``default_init``."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray import load as nd_load
+
+            param = nd_load(param)
+        self.param = {
+            (k[4:] if k.startswith("arg:") or k.startswith("aux:") else k): v
+            for k, v in param.items()
+        }
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            if tuple(self.param[name].shape) != tuple(arr.shape):
+                raise ValueError(
+                    f"Parameter {name} cannot be initialized from loading. "
+                    f"Shape mismatch, target {arr.shape} vs loaded "
+                    f"{self.param[name].shape}"
+                )
+            arr[:] = self.param[name]
+        else:
+            if self.default_init is None:
+                raise ValueError(
+                    f"Cannot Initialize {name}. Not found in loaded param and "
+                    "no default initializer provided."
+                )
+            self.default_init(name, arr)
+
+
+@register
+class Mixed:
+    """Pattern-dispatch between multiple initializers."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must have same length")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError(
+            f"Parameter name {name} did not match any pattern. Consider "
+            'adding a ".*" pattern at the end with default Initializer.'
+        )
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _INIT_REGISTRY[name.lower()](**kwargs)

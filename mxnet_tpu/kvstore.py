@@ -1,0 +1,202 @@
+"""KVStore — key-value store for gradient aggregation and weight sync.
+
+Reference: ``include/mxnet/kvstore.h`` + ``src/kvstore/`` (``KVStore::Create``
+modes ``local``/``device``/``dist_sync``/``dist_device_sync``/``dist_async``,
+kvstore.cc:16-44; CommCPU/CommDevice reduce, comm.h; ps-lite parameter server
+kvstore_dist*.h).
+
+TPU-native design (SURVEY.md §2.5): gradients in this framework come out of
+the executor *already reduced across devices* — data-parallel executors run
+one SPMD program over a device mesh and XLA inserts ``psum`` over ICI for
+replicated-parameter gradients, which is what ``CommDevice::Reduce`` (P2P
+copies + ElementwiseSum) and the ps-lite ZPush/ZPull paths exist to do by
+hand. The KVStore therefore keeps the reference *API* (init/push/pull/
+set_optimizer/rank/num_workers/barrier) as the coordination surface:
+
+* ``local``/``device`` → in-process store; push merges (sums) values and
+  applies the optimizer when ``set_optimizer`` was called
+  (``update_on_kvstore`` path of Module);
+* ``dist_sync``/``dist_device_sync`` → same semantics on a multi-host jax
+  runtime: every host runs the same program, collectives ride ICI/DCN inside
+  the jitted step, and rank/num_workers map to jax process index/count.
+  ``dist_async`` has no idiomatic analogue (documented; created as sync).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+
+
+def _key_str(key):
+    return str(key)
+
+
+class KVStore:
+    """In-process key-value store (covers local + device modes)."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+
+    # --- identity ------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # --- data plane ----------------------------------------------------
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError(f"key {k} already initialized")
+            vv = v[0] if isinstance(v, (list, tuple)) else v
+            self._store[k] = vv.copy()
+
+    def push(self, key, value, priority=0):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if isinstance(v, (list, tuple)):
+                # multi-device push: values from a replicated/sharded run are
+                # already identical post-psum; a genuine per-device list is
+                # tree-summed like CommDevice::Reduce.
+                merged = v[0].copy()
+                for x in v[1:]:
+                    merged += x
+            else:
+                merged = v.copy()
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            if self._updater is not None:
+                self._updater(_updater_key(k), merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0):
+        assert out is not None
+        keys, outs = _key_value(key, out)
+        for k, o in zip(keys, outs):
+            src = self._store[k]
+            if isinstance(o, (list, tuple)):
+                for x in o:
+                    src.copyto(x)
+            else:
+                src.copyto(o)
+
+    # --- optimizer plane ----------------------------------------------
+    def set_optimizer(self, optimizer):
+        from .optimizer import get_updater
+
+        self._optimizer = optimizer
+        self._set_updater(get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    set_updater = _set_updater
+
+    def save_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+    # --- cluster plane -------------------------------------------------
+    def barrier(self):
+        pass
+
+    def _barrier(self):
+        pass
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+    @property
+    def num_dead_node(self):
+        return 0
+
+
+class DistKVStore(KVStore):
+    """Multi-host store over the jax distributed runtime.
+
+    Every host runs the same SPMD program; this class supplies the
+    rank/size/barrier coordination the ps-lite scheduler provided. The data
+    path (gradient reduction) rides XLA collectives inside the jitted step —
+    see mxnet_tpu.parallel.
+    """
+
+    def __init__(self, kv_type):
+        super().__init__(kv_type)
+        import jax
+
+        self._jax = jax
+        if "async" in kv_type:
+            import logging
+
+            logging.warning(
+                "dist_async has no idiomatic TPU analogue (hogwild updates "
+                "do not exist in an SPMD program); running bulk-synchronous "
+                "like dist_sync. See SURVEY.md §2.5."
+            )
+
+    @property
+    def rank(self):
+        return self._jax.process_index()
+
+    @property
+    def num_workers(self):
+        return self._jax.process_count()
+
+    def barrier(self):
+        # A tiny all-reduce across all devices synchronises hosts.
+        import jax
+        import jax.numpy as jnp
+
+        if jax.process_count() > 1:
+            x = jnp.ones((jax.local_device_count(),))
+            jax.block_until_ready(
+                jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x)
+            )
+
+
+def create(name="local"):
+    """Create a KVStore (reference ``mx.kv.create``, kvstore.cc:16-44)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if "dist" in name:
+        return DistKVStore(name)
+    return KVStore(name)
+
+
+def _key_value(keys, vals):
+    if isinstance(keys, (tuple, list)):
+        assert len(keys) == len(vals)
+        out_keys, out_vals = [], []
+        for k, v in zip(keys, vals):
+            out_keys.append(_key_str(k))
+            out_vals.append(v)
+        return out_keys, out_vals
+    return [_key_str(keys)], [vals]
+
+
+def _updater_key(k):
+    try:
+        return int(k)
+    except ValueError:
+        return k

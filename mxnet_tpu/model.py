@@ -1,0 +1,241 @@
+"""Legacy model API + kvstore training helpers.
+
+Reference: ``python/mxnet/model.py`` (951 LoC) — ``BatchEndParam``, the
+kvstore helpers ``_create_kvstore``/``_initialize_kvstore``/
+``_update_params(_on_kvstore)`` (:40-120) used by Module.update, checkpoint
+save/load, and the deprecated ``FeedForward`` scikit-style API (:136+) which
+is kept as a thin veneer over Module.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+
+import numpy as np
+
+from . import io as io_mod
+from . import kvstore as kvs
+from . import symbol as sym_mod
+from .base import MXNetError
+from .context import Context, cpu
+from .initializer import Uniform
+from .ndarray import NDArray, load as nd_load, save as nd_save
+
+BatchEndParam = namedtuple(
+    "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"]
+)
+
+BASE_ESTIMATOR = object
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Create kvstore from --kv-store string (reference model.py:40-66)."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            # one device: no need for a reduction store at all
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(np.prod(param.shape) for param in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    for idx, param_on_devs in enumerate(param_arrays):
+        kvstore.init(idx, arg_params[param_names[idx]])
+        if update_on_kvstore:
+            kvstore.pull(idx, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names=None):
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        kvstore.push(index, grad_list, priority=-index)
+        kvstore.pull(index, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None, param_names=None):
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        if kvstore:
+            kvstore.push(index, grad_list, priority=-index)
+            kvstore.pull(index, grad_list, priority=-index)
+        for k, p in enumerate(zip(arg_list, grad_list)):
+            w, g = p
+            updater(index * num_device + k, g, w)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Save symbol JSON + params (reference model.py save_checkpoint)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    param_name = f"{prefix}-{epoch:04d}.params"
+    nd_save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load (symbol, arg_params, aux_params) (reference load_checkpoint)."""
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    save_dict = nd_load(f"{prefix}-{epoch:04d}.params")
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+class FeedForward(BASE_ESTIMATOR):
+    """Deprecated scikit-style model (reference FeedForward, model.py:136+).
+
+    Kept for script parity; internally delegates to mx.mod.Module.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=Uniform(0.01), numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        self.symbol = symbol
+        if ctx is None:
+            ctx = [cpu()]
+        elif isinstance(ctx, Context):
+            ctx = [ctx]
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.kwargs = kwargs.copy()
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self._pred_exec = None
+
+    def _init_iter(self, X, y, is_train):
+        if isinstance(X, (np.ndarray, NDArray)):
+            if y is None:
+                if is_train:
+                    raise ValueError("y must be specified when X is numpy.ndarray")
+                y = np.zeros(X.shape[0])
+            batch_size = min(X.shape[0], self.numpy_batch_size)
+            return io_mod.NDArrayIter(
+                X, y, batch_size=batch_size, shuffle=is_train,
+                last_batch_handle="roll_over" if is_train else "pad",
+            )
+        return X
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        from .module import Module
+
+        data = self._init_iter(X, y, is_train=True)
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            if isinstance(eval_data, tuple):
+                eval_data = io_mod.NDArrayIter(
+                    eval_data[0], eval_data[1], batch_size=data.batch_size,
+                )
+        label_names = None
+        for name in self.symbol.list_arguments():
+            if name.endswith("_label"):
+                label_names = [name]
+                break
+        mod = Module(
+            self.symbol, context=self.ctx, logger=logger or logging,
+            work_load_list=work_load_list,
+            label_names=label_names or ["softmax_label"],
+        )
+        opt_params = dict(self.kwargs)
+        mod.fit(
+            data, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer,
+            optimizer_params=opt_params or (("learning_rate", 0.01),),
+            initializer=self.initializer, arg_params=self.arg_params,
+            aux_params=self.aux_params, begin_epoch=self.begin_epoch,
+            num_epoch=self.num_epoch, monitor=monitor,
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback,
+        )
+        self.arg_params, self.aux_params = mod.get_params()
+        self._module = mod
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        from .module import Module
+
+        data = self._init_iter(X, None, is_train=False)
+        mod = Module(
+            self.symbol, context=self.ctx,
+            label_names=[n for n in self.symbol.list_arguments() if n.endswith("_label")][:1] or None,
+        )
+        mod.bind(data.provide_data, data.provide_label or None, for_training=False)
+        mod.set_params(self.arg_params, self.aux_params or {}, allow_missing=False)
+        outs = mod.predict(data, num_batch=num_batch, reset=reset)
+        if isinstance(outs, list):
+            return [o.asnumpy() for o in outs]
+        return outs.asnumpy()
+
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch
+        assert epoch is not None
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(
+            symbol, ctx=ctx, arg_params=arg_params, aux_params=aux_params,
+            begin_epoch=epoch, **kwargs,
+        )
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=Uniform(0.01), eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        model = FeedForward(
+            symbol, ctx=ctx, num_epoch=num_epoch, epoch_size=epoch_size,
+            optimizer=optimizer, initializer=initializer, **kwargs,
+        )
+        model.fit(
+            X, y, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            logger=logger, work_load_list=work_load_list,
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback,
+        )
+        return model

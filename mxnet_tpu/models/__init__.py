@@ -1,0 +1,18 @@
+"""Model zoo — symbol builders for the reference's target workloads
+(BASELINE.json configs): MLP/LeNet (MNIST), ResNet-50 (ImageNet DP),
+VGG-16 (SSD backbone), Inception-BN, DCGAN generator/discriminator, and the
+bucketed LSTM language model.
+
+Reference: ``example/image-classification/symbols/*.py`` and
+``example/rnn``/``example/gan``. Builders return plain Symbols usable with
+mx.mod.Module.
+"""
+
+from .mlp import get_symbol as mlp
+from .lenet import get_symbol as lenet
+from .resnet import get_symbol as resnet
+from .vgg import get_symbol as vgg
+from .inception_bn import get_symbol as inception_bn
+from .dcgan import make_generator as dcgan_generator
+from .dcgan import make_discriminator as dcgan_discriminator
+from .lstm_lm import lstm_lm_sym_gen

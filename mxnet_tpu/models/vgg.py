@@ -1,0 +1,54 @@
+"""VGG (reference example/image-classification/symbols/vgg.py; VGG-16 is the
+SSD backbone in example/ssd)."""
+
+from .. import symbol as sym
+
+vgg_spec = {
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+def get_feature(internel_layer, layers, filters, batch_norm=False):
+    for i, num in enumerate(layers):
+        for j in range(num):
+            internel_layer = sym.Convolution(
+                internel_layer, kernel=(3, 3), pad=(1, 1),
+                num_filter=filters[i], name=f"conv{i + 1}_{j + 1}",
+            )
+            if batch_norm:
+                internel_layer = sym.BatchNorm(
+                    internel_layer, name=f"bn{i + 1}_{j + 1}"
+                )
+            internel_layer = sym.Activation(
+                internel_layer, act_type="relu", name=f"relu{i + 1}_{j + 1}"
+            )
+        internel_layer = sym.Pooling(
+            internel_layer, pool_type="max", kernel=(2, 2), stride=(2, 2),
+            name=f"pool{i + 1}",
+        )
+    return internel_layer
+
+
+def get_classifier(input_data, num_classes):
+    flatten = sym.Flatten(input_data, name="flatten")
+    fc6 = sym.FullyConnected(flatten, num_hidden=4096, name="fc6")
+    relu6 = sym.Activation(fc6, act_type="relu", name="relu6")
+    drop6 = sym.Dropout(relu6, p=0.5, name="drop6")
+    fc7 = sym.FullyConnected(drop6, num_hidden=4096, name="fc7")
+    relu7 = sym.Activation(fc7, act_type="relu", name="relu7")
+    drop7 = sym.Dropout(relu7, p=0.5, name="drop7")
+    fc8 = sym.FullyConnected(drop7, num_hidden=num_classes, name="fc8")
+    return fc8
+
+
+def get_symbol(num_classes=1000, num_layers=16, batch_norm=False, **kwargs):
+    if num_layers not in vgg_spec:
+        raise ValueError(f"no experiments done on num_layers {num_layers}")
+    layers, filters = vgg_spec[num_layers]
+    data = sym.Variable(name="data")
+    feature = get_feature(data, layers, filters, batch_norm)
+    classifier = get_classifier(feature, num_classes)
+    return sym.SoftmaxOutput(classifier, name="softmax")

@@ -33,16 +33,56 @@ def _is_np_shape_scalar(x):
     return isinstance(x, (int, float, bool, np.number))
 
 
-class NDArray:
-    """Mutable handle over a jax.Array."""
+class _FnOp:
+    """Tape-recordable wrapper for NDArray method/dunder math so imperative
+    autograd sees them (the reference routes dunders through registered ops;
+    here they call jnp directly for speed and record this shim instead)."""
 
-    __slots__ = ("_data", "_ctx", "_grad", "_autograd_entry", "__weakref__")
+    __slots__ = ("fn",)
+    name = "_fn"
+    need_rng = False
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def apply(self, ins, params, mode):
+        return [self.fn(*ins)], []
+
+
+class NDArray:
+    """Mutable handle over a jax.Array.
+
+    ``_data`` is a property so executor outputs can be *lazy*: an executor
+    hands out output handles immediately and installs ``_lazy`` — the first
+    read of any handle triggers the (single, fused) XLA execution. This is
+    the engine-async analogue of the reference: ``Engine::Push`` returns
+    immediately and ``WaitToRead`` blocks (threaded_engine.cc:258,314).
+    """
+
+    __slots__ = ("_d", "_lazy", "_ctx", "_grad", "_autograd_entry", "__weakref__")
 
     def __init__(self, data, ctx=None):
-        self._data = data
+        self._d = data
+        self._lazy = None
         self._ctx = ctx
         self._grad = None
         self._autograd_entry = None
+
+    @property
+    def _data(self):
+        if self._lazy is not None:
+            cb = self._lazy
+            self._lazy = None
+            cb()
+        return self._d
+
+    @_data.setter
+    def _data(self, value):
+        self._lazy = None
+        self._d = value
+
+    def _set_lazy(self, cb):
+        self._lazy = cb
 
     # --- basic properties -------------------------------------------------
     @property
@@ -93,7 +133,10 @@ class NDArray:
         return self.asnumpy().reshape(-1)[0]
 
     def astype(self, dtype):
-        return NDArray(self._data.astype(np_dtype(dtype)), self._ctx)
+        dt = np_dtype(dtype)
+        return self._record_unary(
+            NDArray(self._data.astype(dt), self._ctx), lambda x: x.astype(dt)
+        )
 
     def copy(self):
         import jax.numpy as jnp
@@ -106,9 +149,11 @@ class NDArray:
         if isinstance(other, NDArray):
             if other is self:
                 return other
+            tgt = other._data
+            placement = tgt.sharding if hasattr(tgt, "sharding") else list(tgt.devices())[0]
             other._data = jax.device_put(
-                self._data, list(other._data.devices())[0]
-            ).astype(other._data.dtype)
+                self._data.astype(tgt.dtype), placement
+            )
             return other
         if isinstance(other, Context):
             return NDArray(jax.device_put(self._data, other.jax_device()), other)
@@ -138,29 +183,43 @@ class NDArray:
         if isinstance(shape, int):
             shape = (shape,)
         out_shape = infer_reshape(self.shape, tuple(shape), kwargs.get("reverse", False))
-        return NDArray(self._data.reshape(out_shape), self._ctx)
+        return self._record_unary(
+            NDArray(self._data.reshape(out_shape), self._ctx),
+            lambda x: x.reshape(out_shape),
+        )
 
     @property
     def T(self):
-        return NDArray(self._data.T, self._ctx)
+        return self._record_unary(
+            NDArray(self._data.T, self._ctx), lambda x: x.T
+        )
 
     def transpose(self, axes=None):
         import jax.numpy as jnp
 
-        return NDArray(jnp.transpose(self._data, axes), self._ctx)
+        return self._record_unary(
+            NDArray(jnp.transpose(self._data, axes), self._ctx),
+            lambda x: jnp.transpose(x, axes),
+        )
 
     def flatten(self):
-        return self.reshape((self.shape[0], -1))
+        return self.reshape((self.shape[0], -1))  # reshape records the tape entry
 
     def expand_dims(self, axis):
         import jax.numpy as jnp
 
-        return NDArray(jnp.expand_dims(self._data, axis), self._ctx)
+        return self._record_unary(
+            NDArray(jnp.expand_dims(self._data, axis), self._ctx),
+            lambda x: jnp.expand_dims(x, axis),
+        )
 
     def broadcast_to(self, shape):
         import jax.numpy as jnp
 
-        return NDArray(jnp.broadcast_to(self._data, shape), self._ctx)
+        return self._record_unary(
+            NDArray(jnp.broadcast_to(self._data, shape), self._ctx),
+            lambda x: jnp.broadcast_to(x, shape),
+        )
 
     def slice(self, begin, end):
         return NDArray(
@@ -174,10 +233,12 @@ class NDArray:
 
     # --- indexing ---------------------------------------------------------
     def __getitem__(self, key):
-        data = self._data[key]
-        return NDArray(data, self._ctx)
+        return self._record_unary(
+            NDArray(self._data[key], self._ctx), lambda x: x[key]
+        )
 
     def __setitem__(self, key, value):
+        import jax
         import jax.numpy as jnp
 
         if isinstance(value, NDArray):
@@ -186,14 +247,20 @@ class NDArray:
             v = jnp.asarray(value, dtype=self.dtype)
         else:
             v = value
+        old = self._data
         if key is Ellipsis or (
             isinstance(key, builtins.slice) and key == builtins.slice(None)
         ):
-            self._data = jnp.broadcast_to(
-                jnp.asarray(v, dtype=self.dtype), self.shape
-            )
+            new = jnp.broadcast_to(jnp.asarray(v, dtype=self.dtype), self.shape)
         else:
-            self._data = self._data.at[key].set(v)
+            new = old.at[key].set(v)
+        # Assignment writes INTO the existing buffer in the reference, so the
+        # device/sharding placement must survive a full-slice assignment —
+        # critical for mesh-sharded executor arrays.
+        if hasattr(old, "sharding") and hasattr(new, "sharding") and \
+                new.sharding != old.sharding and tuple(new.shape) == tuple(old.shape):
+            new = jax.device_put(new, old.sharding)
+        self._data = new
 
     def __len__(self):
         if not self.shape:
@@ -220,14 +287,29 @@ class NDArray:
 
     # --- arithmetic -------------------------------------------------------
     def _binary(self, other, fn, reverse=False):
-        import jax.numpy as jnp
-
         if isinstance(other, NDArray):
             o = other._data
         else:
             o = other
         a, b = (o, self._data) if reverse else (self._data, o)
-        return NDArray(fn(a, b), self._ctx)
+        out = NDArray(fn(a, b), self._ctx)
+        from . import autograd
+
+        if autograd.is_recording():
+            if isinstance(other, NDArray):
+                ins = [other, self] if reverse else [self, other]
+                autograd.record_op(_FnOp(fn), {}, ins, [out])
+            else:
+                g = (lambda x: fn(o, x)) if reverse else (lambda x: fn(x, o))
+                autograd.record_op(_FnOp(g), {}, [self], [out])
+        return out
+
+    def _record_unary(self, out, fn):
+        from . import autograd
+
+        if autograd.is_recording():
+            autograd.record_op(_FnOp(fn), {}, [self], [out])
+        return out
 
     def __add__(self, o):
         import jax.numpy as jnp
@@ -277,18 +359,32 @@ class NDArray:
         return self._binary(o, jnp.power)
 
     def __neg__(self):
-        return NDArray(-self._data, self._ctx)
+        return self._record_unary(NDArray(-self._data, self._ctx), lambda x: -x)
 
     def __abs__(self):
         import jax.numpy as jnp
 
-        return NDArray(jnp.abs(self._data), self._ctx)
+        return self._record_unary(
+            NDArray(jnp.abs(self._data), self._ctx), jnp.abs
+        )
 
     def _inplace(self, other, fn):
-        import jax.numpy as jnp
+        from . import autograd
 
-        o = other._data if isinstance(other, NDArray) else other
-        self._data = fn(self._data, o)
+        if isinstance(other, NDArray):
+            o = other._data
+            ins = [self, other]
+            g = fn
+        else:
+            o = other
+            ins = [self]
+            g = lambda x: fn(x, o)
+        new = fn(self._data, o)
+        if autograd.is_recording():
+            # self is input AND output: sequential tape replay reads the
+            # pre-entry value, then rebinds — mirroring in-place mutation.
+            autograd.record_op(_FnOp(g), {}, ins, [self])
+        self._data = new
         return self
 
     def __iadd__(self, o):
@@ -357,27 +453,42 @@ class NDArray:
     def sum(self, axis=None, keepdims=False):
         import jax.numpy as jnp
 
-        return NDArray(jnp.sum(self._data, axis=axis, keepdims=keepdims))
+        return self._record_unary(
+            NDArray(jnp.sum(self._data, axis=axis, keepdims=keepdims)),
+            lambda x: jnp.sum(x, axis=axis, keepdims=keepdims),
+        )
 
     def mean(self, axis=None, keepdims=False):
         import jax.numpy as jnp
 
-        return NDArray(jnp.mean(self._data, axis=axis, keepdims=keepdims))
+        return self._record_unary(
+            NDArray(jnp.mean(self._data, axis=axis, keepdims=keepdims)),
+            lambda x: jnp.mean(x, axis=axis, keepdims=keepdims),
+        )
 
     def max(self, axis=None, keepdims=False):
         import jax.numpy as jnp
 
-        return NDArray(jnp.max(self._data, axis=axis, keepdims=keepdims))
+        return self._record_unary(
+            NDArray(jnp.max(self._data, axis=axis, keepdims=keepdims)),
+            lambda x: jnp.max(x, axis=axis, keepdims=keepdims),
+        )
 
     def min(self, axis=None, keepdims=False):
         import jax.numpy as jnp
 
-        return NDArray(jnp.min(self._data, axis=axis, keepdims=keepdims))
+        return self._record_unary(
+            NDArray(jnp.min(self._data, axis=axis, keepdims=keepdims)),
+            lambda x: jnp.min(x, axis=axis, keepdims=keepdims),
+        )
 
     def clip(self, a_min, a_max):
         import jax.numpy as jnp
 
-        return NDArray(jnp.clip(self._data, a_min, a_max))
+        return self._record_unary(
+            NDArray(jnp.clip(self._data, a_min, a_max)),
+            lambda x: jnp.clip(x, a_min, a_max),
+        )
 
     def abs(self):
         return self.__abs__()

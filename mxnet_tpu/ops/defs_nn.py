@@ -44,6 +44,15 @@ def _prec(dt):
     return matmul_precision(dt)
 
 
+def _castp(param, data):
+    """Cast a parameter to the activation dtype (mixed precision: master
+    weights stay f32, compute runs in the activation dtype — bf16 on the
+    MXU; the cast's transpose accumulates the gradient back in f32)."""
+    if param is not None and param.dtype != data.dtype:
+        return param.astype(data.dtype)
+    return param
+
+
 # --- FullyConnected --------------------------------------------------------
 def _fc(ins, params, mode):
     if params["no_bias"]:
@@ -51,14 +60,14 @@ def _fc(ins, params, mode):
         bias = None
     else:
         data, weight, bias = ins
+    weight, bias = _castp(weight, data), _castp(bias, data)
     x = data.reshape((data.shape[0], -1))
     out = jax.lax.dot_general(
         x,
         weight,
         (((1,), (1,)), ((), ())),
         precision=_prec(x.dtype),
-        preferred_element_type=_acc(x.dtype),
-    ).astype(x.dtype)
+    )
     if bias is not None:
         out = out + bias
     return out
@@ -101,6 +110,7 @@ def _conv(ins, params, mode):
         bias = None
     else:
         data, weight, bias = ins
+    weight, bias = _castp(weight, data), _castp(bias, data)
     k = params["kernel"]
     nsp = len(k)
     stride = params["stride"] or (1,) * nsp
@@ -115,8 +125,7 @@ def _conv(ins, params, mode):
         dimension_numbers=_conv_dn(data.ndim),
         feature_group_count=params["num_group"],
         precision=_prec(data.dtype),
-        preferred_element_type=_acc(data.dtype),
-    ).astype(data.dtype)
+    )
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nsp)
     return out
@@ -168,6 +177,7 @@ def _deconv(ins, params, mode):
         bias = None
     else:
         data, weight, bias = ins
+    weight, bias = _castp(weight, data), _castp(bias, data)
     k = params["kernel"]
     nsp = len(k)
     stride = params["stride"] or (1,) * nsp
@@ -201,8 +211,7 @@ def _deconv(ins, params, mode):
         dimension_numbers=_conv_dn(data.ndim),
         feature_group_count=ng,
         precision=_prec(data.dtype),
-        preferred_element_type=_acc(data.dtype),
-    ).astype(data.dtype)
+    )
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nsp)
     return out
@@ -324,7 +333,7 @@ def _batch_norm(ins, params, mode):
     inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps).astype(data.dtype)
     out = (data - mean.reshape(bshape).astype(data.dtype)) * inv.reshape(
         bshape
-    ) * gamma.reshape(bshape) + beta.reshape(bshape)
+    ) * _castp(gamma, data).reshape(bshape) + _castp(beta, data).reshape(bshape)
     return [out, out_mean, out_var], new_aux
 
 

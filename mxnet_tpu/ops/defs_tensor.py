@@ -31,9 +31,11 @@ from .registry import Param, register
 def matmul_precision(dt):
     """MXU precision policy: float32 contractions run at HIGHEST (f32
     numerics, parity with the reference's cuBLAS f32 path); bf16/f16 inputs
-    use native MXU passes with f32 accumulation via preferred_element_type.
-    Without this, TPU's default bf16 matmul silently loses ~3 decimal digits
-    on f32 data."""
+    use native MXU passes (XLA accumulates in f32 internally). Without this,
+    TPU's default bf16 matmul silently loses ~3 decimal digits on f32 data.
+    Note: preferred_element_type is deliberately NOT used — jax's conv
+    transpose rule builds mixed-dtype convs from it (bf16 lhs, f32 rhs),
+    which lax rejects."""
     if dt in (jnp.bfloat16, jnp.float16):
         return None
     return jax.lax.Precision.HIGHEST
@@ -53,8 +55,7 @@ def _dot(ins, params, mode):
         b,
         (((a.ndim - 1,), (0,)), ((), ())),
         precision=matmul_precision(a.dtype),
-        preferred_element_type=_acc_type(a.dtype),
-    ).astype(jnp.result_type(a.dtype, b.dtype))
+    )
 
 
 def _acc_type(dt):

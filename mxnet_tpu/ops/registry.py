@@ -238,9 +238,10 @@ class OpDef:
 
 
 def _dummy_key_struct():
+    # concrete key: eval_shape abstracts it, and jax's typed-PRNG checks pass
     import jax
 
-    return jax.ShapeDtypeStruct((2,), np.uint32)
+    return jax.random.PRNGKey(0)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,472 @@
+"""Optimizers.
+
+Reference: ``python/mxnet/optimizer.py`` (805 LoC; classes at
+``optimizer.py:309-756``, ``Updater`` at ``:772-800``). SGD/Adam/RMSProp
+dispatch to the fused update kernels (``src/operator/optimizer_op.cc``) —
+here those are the registered jax ops ``sgd_update``/``sgd_mom_update``/
+``adam_update``/``rmsprop_update``/``rmspropalex_update``, each one fused XLA
+kernel. Other optimizers (DCASGD, NAG, SGLD, AdaGrad, AdaDelta, Ftrl) are
+written with NDArray arithmetic exactly like the reference's python paths.
+
+lr/wd multipliers resolve in the reference's priority order: per-optimizer
+dicts set via ``set_lr_mult``/``set_wd_mult`` > symbol attributes
+(``__lr_mult__``) > defaults (bias/gamma/beta wd_mult=0 heuristic).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros, clip as nd_clip, sgd_update, sgd_mom_update, \
+    adam_update, rmsprop_update, rmspropalex_update, sqrt as nd_sqrt, square as nd_square
+from . import registry as _generic_registry
+
+
+class Optimizer:
+    """Base optimizer (reference ``Optimizer``)."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError(f"Cannot find optimizer {name}")
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), (
+            "param_idx2name should be a dict of param indexes to names."
+        )
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def set_lr_scale(self, args_lrscale):
+        raise DeprecationWarning("Use set_lr_mult instead.")
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+register = Optimizer.register
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum, dispatching to the fused update kernels."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=None, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(
+            lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient if self.clip_gradient is not None else -1.0,
+        )
+        if state is not None:
+            sgd_mom_update(weight, grad, state, out=weight,
+                           momentum=self.momentum, **kwargs)
+        else:
+            sgd_update(weight, grad, out=weight, **kwargs)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, dtype=weight.dtype), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        delay = grad * (weight - previous_weight)
+        if mom is not None:
+            mom *= self.momentum
+            mom -= lr * (grad + wd * weight + self.lamda * grad * delay)
+            update = mom
+        else:
+            update = -lr * (grad + wd * weight + self.lamda * grad * delay)
+        previous_weight[:] = weight
+        weight += update
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference NAG)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom *= self.momentum
+            grad += wd * weight
+            mom += grad
+            grad += self.momentum * mom
+            weight += -lr * grad
+        else:
+            weight += -lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        from .ndarray import normal
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        weight += -lr / 2 * (grad + wd * weight) + normal(
+            loc=0.0, scale=math.sqrt(lr), shape=weight.shape, dtype=weight.dtype
+        )
+
+
+@register
+class CCSGD(SGD):
+    """Kept for backward compatibility (reference ccSGD == SGD)."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs.pop("smooth_decay", None)
+        super().__init__(*args, **kwargs)
+
+
+ccSGD = CCSGD
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, dtype=weight.dtype),  # mean
+            zeros(weight.shape, dtype=weight.dtype),  # variance
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        adam_update(
+            weight, grad, mean, var, out=weight, lr=lr, wd=wd,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient if self.clip_gradient is not None else -1.0,
+        )
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        history = state
+        history += nd_square(grad)
+        weight += (-lr * (grad / nd_sqrt(history + self.float_stable_eps)
+                          + wd * weight))
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp; centered=True uses Alex Graves' variant
+    (reference RMSProp → rmsprop_update / rmspropalex_update kernels)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (
+                zeros(weight.shape, dtype=weight.dtype),  # n
+                zeros(weight.shape, dtype=weight.dtype),  # g
+                zeros(weight.shape, dtype=weight.dtype),  # delta
+            )
+        return (zeros(weight.shape, dtype=weight.dtype),)  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(
+            lr=lr, wd=wd, gamma1=self.gamma1, epsilon=self.epsilon,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient if self.clip_gradient is not None else -1.0,
+            clip_weights=self.clip_weights if self.clip_weights is not None else -1.0,
+        )
+        if not self.centered:
+            (n,) = state
+            rmsprop_update(weight, grad, n, out=weight, **kwargs)
+        else:
+            n, g, delta = state
+            rmspropalex_update(weight, grad, n, g, delta, out=weight,
+                               gamma2=self.gamma2, **kwargs)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, dtype=weight.dtype),  # accumulated g
+            zeros(weight.shape, dtype=weight.dtype),  # accumulated delta
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1.0 - self.rho) * nd_square(grad)
+        current_delta = (
+            nd_sqrt(acc_delta + self.epsilon)
+            / nd_sqrt(acc_g + self.epsilon) * grad
+        )
+        acc_delta[:] = (
+            self.rho * acc_delta + (1.0 - self.rho) * nd_square(current_delta)
+        )
+        weight[:] = weight - current_delta - wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, dtype=weight.dtype),  # z
+            zeros(weight.shape, dtype=weight.dtype),  # n
+        )
+
+    def update(self, index, weight, grad, state):
+        from .ndarray import sign as nd_sign, abs as nd_abs
+
+        self._update_count(index)
+        wd = self._get_wd(index)
+        lr = self._get_lr(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        z, n = state
+        sigma = -nd_sqrt(n)
+        n += nd_square(grad)
+        denom = nd_sqrt(n)
+        sigma += denom
+        sigma /= lr
+        z += grad - sigma * weight
+        # write-back
+        new_w = (nd_sign(z) * self.lamda1 - z) / (
+            (self.beta + denom) / lr + wd
+        ) * (nd_abs(z) > self.lamda1)
+        weight[:] = new_w
+
+
+@register
+class Test(Optimizer):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight[:] = weight + grad * self.rescale_grad
+        state[:] = weight
+
+
+create = Optimizer.create_optimizer
+
+
+class Updater:
+    """Applies an optimizer per-key with lazily-created state
+    (reference ``Updater``, optimizer.py:772-800; shipped to kvstore servers).
+    """
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        raw = pickle.loads(states)
+        self.states = {k: _states_from_numpy(v) for k, v in raw.items()}
+
+    def get_states(self):
+        serializable = {}
+        for k, v in self.states.items():
+            serializable[k] = _states_to_numpy(v)
+        return pickle.dumps(serializable)
+
+
+def _states_to_numpy(v):
+    if v is None:
+        return None
+    if isinstance(v, NDArray):
+        return v.asnumpy()
+    if isinstance(v, (list, tuple)):
+        return tuple(_states_to_numpy(x) for x in v)
+    return v
+
+
+def _states_from_numpy(v):
+    from .ndarray import array as nd_array
+
+    if v is None:
+        return None
+    if isinstance(v, np.ndarray):
+        return nd_array(v, dtype=v.dtype)
+    if isinstance(v, (list, tuple)):
+        return tuple(_states_from_numpy(x) for x in v)
+    return v
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,18 @@
+"""Parallelism utilities — device meshes and shardings.
+
+This is NEW surface relative to the reference (which had no tensor/sequence
+parallelism, SURVEY.md §2.5): mesh construction + named-sharding helpers that
+the executor group, kvstore and multi-host training build on. The mental
+model is the standard TPU recipe: pick a mesh, annotate shardings, let XLA
+insert collectives over ICI/DCN.
+"""
+
+from .mesh import (
+    current_mesh,
+    data_parallel_mesh,
+    get_mesh,
+    make_mesh,
+    replicate,
+    shard_batch,
+    with_mesh,
+)

@@ -1,0 +1,89 @@
+"""Device mesh helpers.
+
+The framework's distributed backbone: every multi-device execution path
+(data-parallel executor groups, the dist kvstore facade, the multi-chip
+dry-run) goes through a ``jax.sharding.Mesh`` built here. Axis names follow
+the scaling-book convention: ``dp`` (data), ``tp`` (tensor), ``pp``
+(pipeline), ``sp`` (sequence).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+
+_state = threading.local()
+
+
+def make_mesh(axis_sizes, devices=None):
+    """Create a Mesh with named axes, e.g. make_mesh({'dp': 4, 'tp': 2}).
+
+    Uses all visible devices by default; total size must divide/match the
+    device count. Multi-host: devices spans all processes (jax global view).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(int(v) for v in axis_sizes.values())
+    if devices is None:
+        devices = jax.devices()
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise MXNetError(
+            f"mesh of size {total} exceeds {len(devices)} visible devices"
+        )
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def data_parallel_mesh(num_devices=None):
+    import jax
+
+    devs = jax.devices()
+    n = num_devices or len(devs)
+    return make_mesh({"dp": n}, devs)
+
+
+def with_mesh(mesh):
+    """Context manager installing a current mesh."""
+
+    class _Ctx:
+        def __enter__(self):
+            _state.mesh = getattr(_state, "mesh", None)
+            self._prev = _state.mesh
+            _state.mesh = mesh
+            return mesh
+
+        def __exit__(self, *a):
+            _state.mesh = self._prev
+
+    return _Ctx()
+
+
+def current_mesh():
+    return getattr(_state, "mesh", None)
+
+
+def get_mesh():
+    m = current_mesh()
+    if m is None:
+        raise MXNetError("no mesh installed; use with_mesh(make_mesh(...))")
+    return m
+
+
+def shard_batch(mesh, axis="dp"):
+    """NamedSharding splitting dim 0 over the given mesh axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis))
+
+
+def replicate(mesh):
+    """NamedSharding replicating across the whole mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())

@@ -1,0 +1,58 @@
+"""Profiler — execution tracing.
+
+Reference: ``python/mxnet/profiler.py:10-38`` + the in-engine profiler
+(``src/engine/profiler.{h,cc}``) dumping Chrome trace-event JSON. TPU
+mapping (SURVEY.md §5): delegate to the jax/XLA profiler, which captures
+device traces (op-level, HBM, MXU utilisation) viewable in
+TensorBoard/Perfetto — strictly more detail than the reference's per-op
+timestamps; the reference python API shape is preserved.
+"""
+
+from __future__ import annotations
+
+import os
+
+_state = {"mode": "symbolic", "filename": "profile.json", "running": False}
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Set up the profiler (reference profiler_set_config)."""
+    _state["mode"] = mode
+    _state["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """'run' starts a jax profiler trace; 'stop' ends it."""
+    import jax
+
+    if state == "run" and not _state["running"]:
+        logdir = os.path.splitext(_state["filename"])[0] + "_trace"
+        jax.profiler.start_trace(logdir)
+        _state["running"] = True
+        _state["logdir"] = logdir
+    elif state == "stop" and _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+
+
+def dump_profile():
+    """Stop tracing and report where the trace landed."""
+    if _state["running"]:
+        profiler_set_state("stop")
+    return _state.get("logdir")
+
+
+class trace_annotation:
+    """Context manager naming a region in the device trace
+    (maps to jax.profiler.TraceAnnotation)."""
+
+    def __init__(self, name):
+        import jax
+
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        return self._ann.__enter__()
+
+    def __exit__(self, *a):
+        return self._ann.__exit__(*a)

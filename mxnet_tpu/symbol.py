@@ -15,6 +15,7 @@ save/load.
 
 from __future__ import annotations
 
+import builtins
 import json
 import sys
 
@@ -129,7 +130,7 @@ class Symbol:
             if index not in names:
                 raise MXNetError(f"cannot find output {index!r} in {names}")
             index = names.index(index)
-        if isinstance(index, slice):
+        if isinstance(index, builtins.slice):
             return Group([Symbol([o]) for o in self._outputs[index]])
         return Symbol([self._outputs[index]])
 
@@ -260,7 +261,12 @@ class Symbol:
                     from .base import parse_shape
 
                     s = parse_shape(node.attrs["__shape__"])
-                    var_shape[node.name] = s
+                    if s is not None and 0 in s:
+                        # partial hint (0 = unknown batch, reference 0-dim
+                        # convention); needs completion by the binder
+                        s = None
+                    else:
+                        var_shape[node.name] = s
                 shapes[id(node)] = [s]
                 continue
             params = node.params()

@@ -1,0 +1,192 @@
+"""Network visualization.
+
+Reference: ``python/mxnet/visualization.py`` — ``print_summary`` (layer table
+with shapes/params) and ``plot_network`` (graphviz digraph).
+"""
+
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+from .symbol import Symbol
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a layer-by-layer summary (reference print_summary)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {x[0] for x in conf["heads"]}
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[: positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    total_params = 0
+
+    def print_layer_summary(node, out_shape):
+        nonlocal total_params
+        op = node["op"]
+        pre_node = []
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+        cur_param = 0
+        attrs = node.get("attrs", {})
+        if op == "Convolution":
+            from .base import parse_shape, parse_bool
+
+            num_filter = int(attrs["num_filter"])
+            kernel = parse_shape(attrs["kernel"])
+            num_group = int(attrs.get("num_group", "1"))
+            cur_param = num_filter * int(attrs.get("__in_channels__", 0) or 1)
+        name = node["name"]
+        first_connection = pre_node[0] if pre_node else ""
+        fields = [
+            f"{name}({op})",
+            f"{out_shape}",
+            f"{cur_param}",
+            first_connection,
+        ]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            fields = ["", "", "", pre_node[i]]
+            print_row(fields, positions)
+
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        if op == "null" and i > 0:
+            continue
+        if op != "null" or i in heads:
+            if show_shape:
+                key = node["name"] + "_output" if op != "null" else node["name"]
+                if key in shape_dict:
+                    out_shape = shape_dict[key][1:]
+        print_layer_summary(node, out_shape)
+        if i == len(nodes) - 1:
+            print("=" * line_length)
+        else:
+            print("_" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz digraph of the network (reference plot_network)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("Draw network requires graphviz library") from e
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    draw_shape = False
+    shape_dict = {}
+    if shape is not None:
+        draw_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {
+        "shape": "box", "fixedsize": "true", "width": "1.3", "height": "0.8034",
+        "style": "filled",
+    }
+    node_attr.update(node_attrs or {})
+    dot = Digraph(name=title, format=save_format)
+    cm = ("#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3", "#fdb462",
+          "#b3de69", "#fccde5")
+
+    def looks_like_weight(name):
+        return name.endswith(("_weight", "_bias", "_beta", "_gamma",
+                              "_moving_var", "_moving_mean"))
+
+    hidden_nodes = set()
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        attr = node_attr.copy()
+        label = name
+        if op == "null":
+            if looks_like_weight(name):
+                if hide_weights:
+                    hidden_nodes.add(name)
+                continue
+            attr["shape"] = "oval"
+            label = name
+            attr["fillcolor"] = cm[0]
+        elif op == "Convolution":
+            a = node.get("attrs", {})
+            label = f"Convolution\n{a.get('kernel','')}/{a.get('stride','')}, {a.get('num_filter','')}"
+            attr["fillcolor"] = cm[1]
+        elif op == "FullyConnected":
+            a = node.get("attrs", {})
+            label = f"FullyConnected\n{a.get('num_hidden','')}"
+            attr["fillcolor"] = cm[1]
+        elif op == "BatchNorm":
+            attr["fillcolor"] = cm[3]
+        elif op == "Activation" or op == "LeakyReLU":
+            a = node.get("attrs", {})
+            label = f"{op}\n{a.get('act_type','')}"
+            attr["fillcolor"] = cm[2]
+        elif op == "Pooling":
+            a = node.get("attrs", {})
+            label = f"Pooling\n{a.get('pool_type','')}, {a.get('kernel','')}/{a.get('stride','')}"
+            attr["fillcolor"] = cm[4]
+        elif op in ("Concat", "Flatten", "Reshape"):
+            attr["fillcolor"] = cm[5]
+        elif op == "Softmax" or op == "SoftmaxOutput":
+            attr["fillcolor"] = cm[6]
+        else:
+            attr["fillcolor"] = cm[7]
+        dot.node(name=name, label=label, **attr)
+
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        inputs = node["inputs"]
+        for item in inputs:
+            input_node = nodes[item[0]]
+            input_name = input_node["name"]
+            if input_name in hidden_nodes:
+                continue
+            attr = {"dir": "back", "arrowtail": "open"}
+            if draw_shape:
+                key = (input_name + "_output" if input_node["op"] != "null"
+                       else input_name)
+                if key in shape_dict:
+                    shape = shape_dict[key][1:]
+                    attr["label"] = "x".join([str(x) for x in shape])
+            dot.edge(tail_name=name, head_name=input_name, **attr)
+    return dot

@@ -1,0 +1,18 @@
+"""Test configuration: force the CPU backend with 8 virtual devices.
+
+The axon site config pins JAX_PLATFORMS=axon (one real TPU chip); unit tests
+run on XLA:CPU with an 8-device virtual mesh so multi-chip semantics are
+testable without hardware (SURVEY.md §4 implication). Must happen before the
+jax backend initialises.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,112 @@
+"""Executor tests (reference test_executor.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import assert_almost_equal
+
+rs = np.random.RandomState(11)
+
+
+def test_bind_forward_backward():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b * 2
+    x = rs.randn(3, 4).astype(np.float32)
+    y = rs.randn(3, 4).astype(np.float32)
+    exe = c.bind(
+        mx.cpu(), args={"a": mx.nd.array(x), "b": mx.nd.array(y)},
+        args_grad={"a": mx.nd.zeros(x.shape), "b": mx.nd.zeros(y.shape)},
+    )
+    exe.forward(is_train=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), x + 2 * y)
+    og = rs.randn(3, 4).astype(np.float32)
+    exe.backward(mx.nd.array(og))
+    assert_almost_equal(exe.grad_dict["a"].asnumpy(), og)
+    assert_almost_equal(exe.grad_dict["b"].asnumpy(), 2 * og)
+
+
+def test_simple_bind_allocates():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=6, name="fc")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(4, 8))
+    assert exe.arg_dict["fc_weight"].shape == (6, 8)
+    assert exe.arg_dict["fc_bias"].shape == (6,)
+    assert exe.grad_dict["fc_weight"].shape == (6, 8)
+    exe.forward(is_train=False)
+    assert exe.outputs[0].shape == (4, 6)
+
+
+def test_forward_kwargs_update():
+    net = mx.sym.square(mx.sym.Variable("x"))
+    exe = net.simple_bind(ctx=mx.cpu(), x=(2, 2), grad_req="null")
+    exe.forward(x=mx.nd.array([[1, 2], [3, 4]]))
+    assert_almost_equal(exe.outputs[0].asnumpy(), [[1, 4], [9, 16]])
+    exe.forward(x=mx.nd.array([[2, 2], [2, 2]]))
+    assert_almost_equal(exe.outputs[0].asnumpy(), [[4, 4], [4, 4]])
+
+
+def test_outputs_persistent_handles():
+    net = mx.sym.Variable("x") * 2
+    exe = net.simple_bind(ctx=mx.cpu(), x=(2,), grad_req="null")
+    exe.forward(x=mx.nd.array([1.0, 2.0]))
+    out = exe.outputs[0]
+    assert_almost_equal(out.asnumpy(), [2, 4])
+    exe.forward(x=mx.nd.array([5.0, 6.0]))
+    # same handle updates in place (reference persistent outputs)
+    assert_almost_equal(out.asnumpy(), [10, 12])
+
+
+def test_copy_params_from():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3, name="fc")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    w = rs.randn(3, 4).astype(np.float32)
+    exe.copy_params_from({"fc_weight": mx.nd.array(w)}, allow_extra_params=True)
+    assert_almost_equal(exe.arg_dict["fc_weight"].asnumpy(), w)
+    with pytest.raises(MXNetError):
+        exe.copy_params_from({"nonexistent": mx.nd.zeros((1,))})
+
+
+def test_monitor_callback_interpret_mode():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = mx.sym.Activation(net, act_type="relu", name="act")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    seen = []
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    exe.forward(is_train=False, data=mx.nd.ones((2, 4)))
+    assert "fc_output" in seen
+    assert "act_output" in seen
+
+
+def test_executor_reshape():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3, name="fc")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(4, 8))
+    w = exe.arg_dict["fc_weight"]
+    exe2 = exe.reshape(data=(16, 8))
+    assert exe2.arg_dict["data"].shape == (16, 8)
+    # parameters are shared, not copied
+    assert exe2.arg_dict["fc_weight"] is w
+    exe2.forward(is_train=False, data=mx.nd.ones((16, 8)))
+    assert exe2.outputs[0].shape == (16, 3)
+
+
+def test_rng_determinism_per_step():
+    net = mx.sym.Dropout(mx.sym.Variable("x"), p=0.5)
+    exe = net.simple_bind(ctx=mx.cpu(), x=(50, 50), grad_req="null")
+    exe.forward(is_train=True, x=mx.nd.ones((50, 50)))
+    m1 = exe.outputs[0].asnumpy()
+    exe.forward(is_train=True, x=mx.nd.ones((50, 50)))
+    m2 = exe.outputs[0].asnumpy()
+    assert not np.array_equal(m1, m2)  # different step → different mask
+
+
+def test_multi_output_executor():
+    x = mx.sym.Variable("x")
+    parts = mx.sym.SliceChannel(x, num_outputs=2, name="sc")
+    grouped = mx.sym.Group([parts[0] * 2, parts[1] + 1])
+    exe = grouped.simple_bind(ctx=mx.cpu(), x=(2, 4), grad_req="null")
+    exe.forward(x=mx.nd.array([[1, 2, 3, 4], [5, 6, 7, 8]]))
+    assert_almost_equal(exe.outputs[0].asnumpy(), [[2, 4], [10, 12]])
+    assert_almost_equal(exe.outputs[1].asnumpy(), [[4, 5], [8, 9]])

@@ -1,0 +1,309 @@
+"""IO, metric, kvstore, initializer, autograd, random tests
+(reference test_io.py, test_metric.py, test_kvstore.py, test_init.py,
+test_autograd.py, test_random.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# --- io --------------------------------------------------------------------
+def test_ndarray_iter():
+    X = np.arange(40).reshape(10, 4).astype(np.float32)
+    Y = np.arange(10).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    np.testing.assert_array_equal(batches[0].data[0].asnumpy(), X[:3])
+    it.reset()
+    assert len(list(it)) == 4
+    # discard mode
+    it2 = mx.io.NDArrayIter(X, Y, batch_size=3, last_batch_handle="discard")
+    assert len(list(it2)) == 3
+
+
+def test_ndarray_iter_dict_data():
+    data = {"a": np.zeros((10, 2)), "b": np.ones((10, 3))}
+    it = mx.io.NDArrayIter(data, batch_size=5)
+    names = sorted(d.name for d in it.provide_data)
+    assert names == ["a", "b"]
+    batch = next(it)
+    assert len(batch.data) == 2
+
+
+def test_resize_iter():
+    X = np.zeros((10, 2), dtype=np.float32)
+    base = mx.io.NDArrayIter(X, np.zeros(10), batch_size=5)
+    r = mx.io.ResizeIter(base, 5)
+    assert len(list(r)) == 5
+
+
+def test_prefetching_iter():
+    X = np.arange(20).reshape(10, 2).astype(np.float32)
+    base = mx.io.NDArrayIter(X, np.zeros(10), batch_size=5)
+    pre = mx.io.PrefetchingIter(base)
+    batches = list(pre)
+    assert len(batches) == 2
+    np.testing.assert_array_equal(batches[0].data[0].asnumpy(), X[:5])
+
+
+def test_csv_iter():
+    with tempfile.TemporaryDirectory() as td:
+        data_path = os.path.join(td, "data.csv")
+        X = np.random.rand(10, 3).astype(np.float32)
+        np.savetxt(data_path, X, delimiter=",")
+        it = mx.io.CSVIter(data_csv=data_path, data_shape=(3,), batch_size=5)
+        batch = next(it)
+        assert batch.data[0].shape == (5, 3)
+        assert_almost_equal(batch.data[0].asnumpy(), X[:5], rtol=1e-5)
+
+
+# --- metric ----------------------------------------------------------------
+def test_accuracy_metric():
+    m = mx.metric.Accuracy()
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_topk_metric():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.7, 0.2, 0.1]])
+    label = mx.nd.array([2, 1])
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0  # both in top-2
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([0.0, 4.0])
+    mse = mx.metric.MSE()
+    mse.update([label], [pred])
+    assert abs(mse.get()[1] - (1 + 4) / 2) < 1e-6
+    mae = mx.metric.MAE()
+    mae.update([label], [pred])
+    assert abs(mae.get()[1] - 1.5) < 1e-6
+
+
+def test_perplexity():
+    m = mx.metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(m.get()[1] - expected) < 1e-5
+
+
+def test_composite_and_create():
+    m = mx.metric.create(["acc", "mse"])
+    assert isinstance(m, mx.metric.CompositeEvalMetric)
+    m2 = mx.metric.create("acc")
+    assert isinstance(m2, mx.metric.Accuracy)
+    m3 = mx.metric.np(lambda label, pred: float((label == pred.argmax(axis=1)).mean()))
+    pred = mx.nd.array([[0.1, 0.9]])
+    m3.update([mx.nd.array([1])], [pred])
+    assert m3.get()[1] == 1.0
+
+
+# --- kvstore ---------------------------------------------------------------
+def test_kvstore_init_push_pull():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones((2, 3)))
+    out = mx.nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones((2, 3)))
+    kv.push(3, mx.nd.ones((2, 3)) * 4)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), 4 * np.ones((2, 3)))
+
+
+def test_kvstore_aggregation():
+    kv = mx.kv.create("device")
+    kv.init("w", mx.nd.zeros((2,)))
+    kv.push("w", [mx.nd.ones((2,)), mx.nd.ones((2,)) * 2, mx.nd.ones((2,)) * 3])
+    out = mx.nd.zeros((2,))
+    kv.pull("w", out=out)
+    assert_almost_equal(out.asnumpy(), [6, 6])
+
+
+def test_kvstore_updater():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones((2,)))
+    kv._set_updater(lambda key, grad, weight: weight.__isub__(0.1 * grad))
+    kv.push(0, mx.nd.ones((2,)))
+    out = mx.nd.zeros((2,))
+    kv.pull(0, out=out)
+    assert_almost_equal(out.asnumpy(), [0.9, 0.9])
+
+
+def test_kvstore_list_keys():
+    kv = mx.kv.create("local")
+    keys = [5, 7, 9]
+    kv.init(keys, [mx.nd.ones((2,))] * 3)
+    outs = [mx.nd.zeros((2,)) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        assert_almost_equal(o.asnumpy(), np.ones(2))
+    assert kv.rank == 0 and kv.num_workers == 1
+
+
+# --- initializer -----------------------------------------------------------
+def test_initializers():
+    w = mx.nd.zeros((100, 50))
+    mx.init.Xavier()( "fc_weight", w)
+    data = w.asnumpy()
+    bound = np.sqrt(3.0 / ((100 + 50) / 2))
+    assert abs(data.mean()) < 0.05
+    assert data.max() <= bound + 1e-6 and data.min() >= -bound - 1e-6
+    mx.init.Normal(0.1)("fc_weight", w)
+    assert abs(w.asnumpy().std() - 0.1) < 0.02
+    mx.init.Constant(3.5)("fc_weight", w)
+    assert (w.asnumpy() == 3.5).all()
+    b = mx.nd.ones((10,))
+    mx.init.Uniform()("fc_bias", b)  # bias rule → zeros
+    assert (b.asnumpy() == 0).all()
+    g = mx.nd.zeros((10,))
+    mx.init.Uniform()("bn_gamma", g)
+    assert (g.asnumpy() == 1).all()
+    o = mx.nd.zeros((20, 20))
+    mx.init.Orthogonal()("fc_weight", o)
+    q = o.asnumpy() / 1.414
+    assert_almost_equal(q @ q.T, np.eye(20), rtol=1e-3, atol=1e-4)
+
+
+def test_mixed_initializer():
+    init = mx.init.Mixed(
+        [".*bias", ".*"], [mx.init.Zero(), mx.init.Uniform(0.1)]
+    )
+    b = mx.nd.ones((4,))
+    init("fc_bias", b)
+    assert (b.asnumpy() == 0).all()
+
+
+# --- autograd --------------------------------------------------------------
+def test_autograd_basic():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.square(x) * 2
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 4 * np.array([1, 2, 3]), rtol=1e-5)
+
+
+def test_autograd_chain():
+    x = mx.nd.array([[0.1, 0.2]])
+    w = mx.nd.array([[0.3], [0.4]])
+    x.attach_grad()
+    w.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.dot(x, w)
+        z = mx.nd.tanh(y)
+    z.backward()
+    t = np.tanh(0.11)
+    assert_almost_equal(
+        w.grad.asnumpy(), (1 - t ** 2) * np.array([[0.1], [0.2]]), rtol=1e-4,
+        atol=1e-6,
+    )
+
+
+def test_autograd_grad_fn():
+    x = mx.nd.array([2.0])
+    with mx.autograd.record():
+        y = x * x * x
+    (dx,) = mx.autograd.grad([y], [x])
+    assert_almost_equal(dx.asnumpy(), [12.0], rtol=1e-5)
+
+
+def test_autograd_train_mode():
+    assert not mx.autograd.is_training()
+    with mx.autograd.record(train_mode=True):
+        assert mx.autograd.is_training()
+        with mx.autograd.predict_mode():
+            assert not mx.autograd.is_training()
+    assert not mx.autograd.is_training()
+
+
+# --- random ----------------------------------------------------------------
+def test_random_seed_determinism():
+    mx.random.seed(77)
+    a = mx.nd.uniform(shape=(5, 5)).asnumpy()
+    mx.random.seed(77)
+    b = mx.nd.uniform(shape=(5, 5)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = mx.nd.uniform(shape=(5, 5)).asnumpy()
+    assert not np.array_equal(b, c)
+
+
+def test_random_distributions():
+    mx.random.seed(0)
+    u = mx.nd.uniform(low=-2, high=2, shape=(2000,)).asnumpy()
+    assert -2 <= u.min() and u.max() <= 2
+    assert abs(u.mean()) < 0.15
+    n = mx.nd.normal(loc=1.0, scale=2.0, shape=(2000,)).asnumpy()
+    assert abs(n.mean() - 1.0) < 0.2
+    assert abs(n.std() - 2.0) < 0.2
+    g = mx.nd.random_gamma(alpha=3.0, beta=2.0, shape=(2000,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.5
+    e = mx.nd.random_exponential(lam=2.0, shape=(2000,)).asnumpy()
+    assert abs(e.mean() - 0.5) < 0.1
+    p = mx.nd.random_poisson(lam=4.0, shape=(2000,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.3
+
+
+# --- recordio --------------------------------------------------------------
+def test_recordio_roundtrip():
+    from mxnet_tpu import recordio
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "test.rec")
+        writer = recordio.MXRecordIO(path, "w")
+        for i in range(5):
+            writer.write(f"record{i}".encode())
+        writer.close()
+        reader = recordio.MXRecordIO(path, "r")
+        for i in range(5):
+            assert reader.read() == f"record{i}".encode()
+        assert reader.read() is None
+        reader.close()
+
+
+def test_indexed_recordio():
+    from mxnet_tpu import recordio
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "test.rec")
+        idx_path = os.path.join(td, "test.idx")
+        writer = recordio.MXIndexedRecordIO(idx_path, path, "w")
+        for i in range(5):
+            writer.write_idx(i, f"record{i}".encode())
+        writer.close()
+        reader = recordio.MXIndexedRecordIO(idx_path, path, "r")
+        assert reader.read_idx(3) == b"record3"
+        assert reader.read_idx(0) == b"record0"
+        reader.close()
+
+
+def test_recordio_pack_unpack():
+    from mxnet_tpu import recordio
+
+    header = recordio.IRHeader(0, 2.0, 7, 0)
+    packed = recordio.pack(header, b"payload")
+    h, payload = recordio.unpack(packed)
+    assert h.label == 2.0 and h.id == 7
+    assert payload == b"payload"
+    # vector label
+    header2 = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0]), 8, 0)
+    packed2 = recordio.pack(header2, b"xyz")
+    h2, payload2 = recordio.unpack(packed2)
+    np.testing.assert_array_equal(h2.label, [1, 2, 3])
+    assert payload2 == b"xyz"

@@ -1,0 +1,215 @@
+"""Module tests incl. training convergence (reference test_module.py +
+trainer smoke tests tests/python/train/test_mlp.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+rs = np.random.RandomState(5)
+
+
+def _toy_data(n=512, d=16, k=3, seed=42):
+    r = np.random.RandomState(seed)
+    W = r.randn(d, k)
+    X = r.randn(n, d).astype(np.float32)
+    Y = np.argmax(X @ W, axis=1).astype(np.float32)
+    return X, Y
+
+
+def _mlp(k=3):
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=24, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=k, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_basic_api():
+    net = _mlp()
+    mod = mx.mod.Module(net)
+    assert mod.data_names == ["data"]
+    assert mod.label_names == ["softmax_label"]
+    mod.bind(data_shapes=[("data", (8, 16))], label_shapes=[("softmax_label", (8,))])
+    assert mod.binded
+    mod.init_params()
+    assert mod.params_initialized
+    arg_params, aux_params = mod.get_params()
+    assert set(arg_params) == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+    assert mod.output_shapes[0][1] == (8, 3)
+
+
+def test_module_fit_converges():
+    X, Y = _toy_data()
+    train = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True)
+    val = mx.io.NDArrayIter(X, Y, batch_size=64)
+    mod = mx.mod.Module(_mlp())
+    mod.fit(
+        train, optimizer="sgd", optimizer_params={"learning_rate": 0.5},
+        num_epoch=10, initializer=mx.init.Xavier(),
+    )
+    acc = mod.score(val, "acc")[0][1]
+    assert acc > 0.9, f"accuracy {acc}"
+
+
+def test_module_fit_adam():
+    X, Y = _toy_data()
+    train = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True)
+    val = mx.io.NDArrayIter(X, Y, batch_size=64)
+    mod = mx.mod.Module(_mlp())
+    mod.fit(
+        train, optimizer="adam", optimizer_params={"learning_rate": 0.05},
+        num_epoch=10, initializer=mx.init.Xavier(),
+    )
+    acc = mod.score(val, "acc")[0][1]
+    assert acc > 0.9, f"accuracy {acc}"
+
+
+def test_module_update_on_kvstore_paths():
+    """Both update paths (local updater vs kvstore updater) must agree."""
+    X, Y = _toy_data(n=128)
+    results = {}
+    for kv in [None, "local"]:
+        mx.random.seed(0)
+        train = mx.io.NDArrayIter(X, Y, batch_size=32)
+        mod = mx.mod.Module(_mlp())
+        mod.fit(
+            train, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1}, num_epoch=3,
+            initializer=mx.init.Uniform(0.05),
+        )
+        arg_params, _ = mod.get_params()
+        results[str(kv)] = {k: v.asnumpy() for k, v in arg_params.items()}
+    for k in results["None"]:
+        assert_almost_equal(
+            results["None"][k], results["local"][k], rtol=1e-4, atol=1e-5,
+            names=(f"no-kv:{k}", f"local-kv:{k}"),
+        )
+
+
+def test_module_checkpoint_roundtrip():
+    X, Y = _toy_data(n=128)
+    train = mx.io.NDArrayIter(X, Y, batch_size=32)
+    val = mx.io.NDArrayIter(X, Y, batch_size=32)
+    mod = mx.mod.Module(_mlp())
+    mod.fit(
+        train, optimizer="sgd", optimizer_params={"learning_rate": 0.2},
+        num_epoch=3, initializer=mx.init.Xavier(),
+    )
+    score = mod.score(val, "acc")[0][1]
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "m")
+        mod.save_checkpoint(prefix, 3, save_optimizer_states=True)
+        assert os.path.exists(f"{prefix}-symbol.json")
+        assert os.path.exists(f"{prefix}-0003.params")
+        assert os.path.exists(f"{prefix}-0003.states")
+        mod2 = mx.mod.Module.load(prefix, 3)
+        mod2.bind(val.provide_data, val.provide_label, for_training=False)
+        assert mod2.score(val, "acc")[0][1] == score
+
+
+def test_module_predict():
+    X, Y = _toy_data(n=128)
+    val = mx.io.NDArrayIter(X, Y, batch_size=32)
+    mod = mx.mod.Module(_mlp())
+    mod.bind(val.provide_data, val.provide_label, for_training=False)
+    mod.init_params()
+    out = mod.predict(val)
+    assert out.shape == (128, 3)
+    assert_almost_equal(out.asnumpy().sum(axis=1), np.ones(128), rtol=1e-4)
+
+
+def test_module_forward_reshape():
+    mod = mx.mod.Module(_mlp())
+    mod.bind(data_shapes=[("data", (8, 16))], label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = mx.io.DataBatch(
+        data=[mx.nd.ones((4, 16))], label=[mx.nd.zeros((4,))],
+        provide_data=[mx.io.DataDesc("data", (4, 16))],
+        provide_label=[mx.io.DataDesc("softmax_label", (4,))],
+    )
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (4, 3)
+
+
+def test_module_fixed_params():
+    net = _mlp()
+    mod = mx.mod.Module(net, fixed_param_names=["fc1_weight", "fc1_bias"])
+    mod.bind(data_shapes=[("data", (8, 16))], label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 1.0})
+    w_before = mod._exec_group._exec.arg_dict["fc1_weight"].asnumpy().copy()
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.randn(8, 16).astype(np.float32))],
+        label=[mx.nd.zeros((8,))],
+    )
+    mod.forward_backward(batch)
+    mod.update()
+    w_after = mod._exec_group._exec.arg_dict["fc1_weight"].asnumpy()
+    assert np.array_equal(w_before, w_after)  # frozen
+    # but fc2 moved
+    assert not np.array_equal(
+        w_before.sum(), mod._exec_group._exec.arg_dict["fc2_weight"].asnumpy().sum()
+    )
+
+
+def test_sequential_module():
+    net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8, name="fc1")
+    net2 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3, name="fc2"),
+        name="softmax",
+    )
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, label_names=None))
+    seq.add(
+        mx.mod.Module(net2), take_labels=True, auto_wiring=True
+    )
+    X, Y = _toy_data(n=64)
+    train = mx.io.NDArrayIter(X, Y, batch_size=32)
+    seq.bind(train.provide_data, train.provide_label)
+    seq.init_params()
+    seq.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    batch = next(iter(train))
+    seq.forward(batch)
+    assert seq.get_outputs()[0].shape == (32, 3)
+    seq.backward()
+    seq.update()
+
+
+def test_bucketing_module():
+    """LSTM-free bucketing check: per-bucket graphs share params."""
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(
+            data, input_dim=10, output_dim=6, name="shared_emb"
+        )
+        pooled = mx.sym.sum(emb, axis=1)  # (batch, 6), invariant to seq_len
+        net = mx.sym.FullyConnected(pooled, num_hidden=4, name="shared_fc")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind(
+        data_shapes=[("data", (4, 8))], label_shapes=[("softmax_label", (4,))]
+    )
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    for key, dshape in [(8, (4, 8)), (4, (4, 4)), (8, (4, 8))]:
+        batch = mx.io.DataBatch(
+            data=[mx.nd.ones(dshape)], label=[mx.nd.zeros((4,))],
+            bucket_key=key,
+            provide_data=[mx.io.DataDesc("data", dshape)],
+            provide_label=[mx.io.DataDesc("softmax_label", (4,))],
+        )
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    # same weight object across buckets → shapes differ in data, weight shared
+    w8 = mod._buckets[8]._exec_group._exec.arg_dict.get("shared_fc_weight")
+    w4 = mod._buckets[4]._exec_group._exec.arg_dict.get("shared_fc_weight")
+    assert w8 is not None and w4 is not None
+    assert np.array_equal(w8.asnumpy(), w4.asnumpy())

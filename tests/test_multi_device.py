@@ -1,0 +1,127 @@
+"""Multi-device tests on the 8-device virtual CPU mesh
+(reference test_multi_device_exec.py, test_model_parallel.py, and the
+distributed-semantics strategy of SURVEY.md §4: process-level fakes)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _toy(n=512, d=16, k=3, seed=42):
+    r = np.random.RandomState(seed)
+    W = r.randn(d, k)
+    X = r.randn(n, d).astype(np.float32)
+    Y = np.argmax(X @ W, axis=1).astype(np.float32)
+    return X, Y
+
+
+def _mlp(k=3):
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=24, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=k, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_eight_device_data_parallel_converges():
+    X, Y = _toy()
+    train = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True)
+    val = mx.io.NDArrayIter(X, Y, batch_size=64)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(8)])
+    mod.fit(
+        train, optimizer="sgd", optimizer_params={"learning_rate": 0.5},
+        num_epoch=10, initializer=mx.init.Xavier(),
+    )
+    acc = mod.score(val, "acc")[0][1]
+    assert acc > 0.9
+
+    exe = mod._exec_group._exec
+    # data sharded over dp, params replicated (XLA inserts the psum)
+    assert str(exe.arg_dict["data"]._data.sharding.spec) == "PartitionSpec('dp',)"
+    assert str(exe.arg_dict["fc1_weight"]._data.sharding.spec) == "PartitionSpec()"
+
+
+def test_multi_device_matches_single_device():
+    """DP over 8 devices must produce identical updates to 1 device
+    (the reference's convergence-parity claim, BASELINE.md)."""
+    X, Y = _toy(n=128)
+    params = {}
+    for ctxs in [[mx.cpu()], [mx.cpu(i) for i in range(8)]]:
+        mx.random.seed(3)
+        train = mx.io.NDArrayIter(X, Y, batch_size=32)
+        mod = mx.mod.Module(_mlp(), context=ctxs)
+        mod.fit(
+            train, optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            num_epoch=2, initializer=mx.init.Uniform(0.05),
+        )
+        arg_params, _ = mod.get_params()
+        params[len(ctxs)] = {k: v.asnumpy() for k, v in arg_params.items()}
+    for k in params[1]:
+        assert_almost_equal(
+            params[1][k], params[8][k], rtol=1e-4, atol=1e-5,
+            names=(f"1dev:{k}", f"8dev:{k}"),
+        )
+
+
+def test_mesh_helpers():
+    import jax
+
+    mesh = mx.parallel.make_mesh({"dp": 4, "tp": 2})
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    sharding = mx.parallel.shard_batch(mesh, "dp")
+    x = jax.device_put(np.zeros((8, 4), dtype=np.float32), sharding)
+    assert len(x.sharding.device_set) == 8
+
+    with mx.parallel.with_mesh(mesh):
+        assert mx.parallel.current_mesh() is mesh
+    assert mx.parallel.current_mesh() is None
+
+
+def test_spmd_psum_gradient_correctness():
+    """Gradients from the sharded executor must equal the single-device
+    gradients exactly (the psum XLA inserts = CommDevice::Reduce)."""
+    X = np.random.RandomState(0).randn(32, 8).astype(np.float32)
+    Y = np.zeros(32, dtype=np.float32)
+    net = _mlp()
+
+    grads = {}
+    for ctxs in [[mx.cpu()], [mx.cpu(i) for i in range(8)]]:
+        mod = mx.mod.Module(net, context=ctxs)
+        mod.bind(
+            data_shapes=[("data", (32, 8))],
+            label_shapes=[("softmax_label", (32,))],
+        )
+        mx.random.seed(1)
+        mod.init_params(initializer=mx.init.Uniform(0.1), force_init=True)
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(X)], label=[mx.nd.array(Y)]
+        )
+        mod.forward_backward(batch)
+        exe = mod._exec_group._exec
+        grads[len(ctxs)] = {
+            n: exe.grad_dict[n].asnumpy() for n in exe.grad_dict
+        }
+    for name in grads[1]:
+        assert_almost_equal(
+            grads[1][name], grads[8][name], rtol=1e-4, atol=1e-6,
+            names=(f"1dev:{name}", f"8dev:{name}"),
+        )
+
+
+def test_model_parallel_ctx_group_accepted():
+    """group2ctx placement (reference test_model_parallel.py) — attr plumbing
+    works; sharded placement is a TODO recorded in the executor."""
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.Variable("a")
+    with mx.AttrScope(ctx_group="dev2"):
+        b = mx.sym.Variable("b")
+    c = a + b
+    exe = c.bind(
+        mx.cpu(),
+        args={"a": mx.nd.ones((2,)), "b": mx.nd.ones((2,))},
+        group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)},
+    )
+    exe.forward()
+    assert_almost_equal(exe.outputs[0].asnumpy(), [2, 2])

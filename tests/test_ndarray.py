@@ -1,0 +1,188 @@
+"""NDArray tests (reference tests/python/unittest/test_ndarray.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, same
+
+
+def test_ndarray_creation():
+    a = mx.nd.array([1, 2, 3])
+    assert a.shape == (3,)
+    assert a.dtype == np.float32
+    b = mx.nd.zeros((2, 3))
+    assert same(b.asnumpy(), np.zeros((2, 3)))
+    c = mx.nd.ones((2, 3), dtype="int32")
+    assert c.dtype == np.int32
+    d = mx.nd.full((2, 2), 7.5)
+    assert same(d.asnumpy(), np.full((2, 2), 7.5, dtype=np.float32))
+    e = mx.nd.arange(0, 10, 2)
+    assert same(e.asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_ndarray_elementwise():
+    rs = np.random.RandomState(0)
+    x = rs.randn(3, 4).astype(np.float32)
+    y = rs.randn(3, 4).astype(np.float32)
+    a, b = mx.nd.array(x), mx.nd.array(y)
+    assert_almost_equal((a + b).asnumpy(), x + y)
+    assert_almost_equal((a - b).asnumpy(), x - y)
+    assert_almost_equal((a * b).asnumpy(), x * y)
+    assert_almost_equal((a / b).asnumpy(), x / y, rtol=1e-5, atol=1e-5)
+    assert_almost_equal((a + 2).asnumpy(), x + 2)
+    assert_almost_equal((2 - a).asnumpy(), 2 - x)
+    assert_almost_equal((a ** 2).asnumpy(), x ** 2)
+    assert_almost_equal((-a).asnumpy(), -x)
+    assert_almost_equal(abs(a).asnumpy(), np.abs(x))
+
+
+def test_ndarray_inplace():
+    x = np.ones((2, 2), dtype=np.float32)
+    a = mx.nd.array(x)
+    a += 1
+    assert same(a.asnumpy(), x + 1)
+    a *= 3
+    assert same(a.asnumpy(), (x + 1) * 3)
+    a -= 2
+    a /= 2
+    assert_almost_equal(a.asnumpy(), ((x + 1) * 3 - 2) / 2)
+
+
+def test_ndarray_setitem():
+    a = mx.nd.zeros((3, 4))
+    a[:] = 5
+    assert same(a.asnumpy(), np.full((3, 4), 5, dtype=np.float32))
+    a[1, 2] = 9
+    expected = np.full((3, 4), 5, dtype=np.float32)
+    expected[1, 2] = 9
+    assert same(a.asnumpy(), expected)
+    a[0] = np.arange(4)
+    expected[0] = np.arange(4)
+    assert same(a.asnumpy(), expected)
+
+
+def test_ndarray_indexing():
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    a = mx.nd.array(x)
+    assert same(a[1].asnumpy(), x[1])
+    assert same(a[0, 1].asnumpy(), x[0, 1])
+    assert same(a[:, 1:3].asnumpy(), x[:, 1:3])
+    assert a[1, 2, 3].asscalar() == x[1, 2, 3]
+
+
+def test_ndarray_reshape():
+    a = mx.nd.arange(0, 24)
+    b = a.reshape((2, 3, 4))
+    assert b.shape == (2, 3, 4)
+    c = b.reshape((-1, 4))
+    assert c.shape == (6, 4)
+    d = b.reshape((0, -1))  # mxnet special code 0 = copy dim
+    assert d.shape == (2, 12)
+    e = b.reshape((-3, 4))  # merge first two dims
+    assert e.shape == (6, 4)
+
+
+def test_ndarray_copy():
+    a = mx.nd.array([[1, 2], [3, 4]])
+    b = a.copy()
+    b[0, 0] = 99
+    assert a[0, 0].asscalar() == 1
+    c = mx.nd.zeros((2, 2))
+    a.copyto(c)
+    assert same(c.asnumpy(), a.asnumpy())
+
+
+def test_ndarray_dtype_cast():
+    a = mx.nd.ones((2, 2))
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.astype("float16")
+    assert c.dtype == np.float16
+
+
+def test_ndarray_ops():
+    rs = np.random.RandomState(3)
+    x = rs.rand(4, 5).astype(np.float32) + 0.5
+    a = mx.nd.array(x)
+    assert_almost_equal(mx.nd.sqrt(a).asnumpy(), np.sqrt(x), rtol=1e-5)
+    assert_almost_equal(mx.nd.exp(a).asnumpy(), np.exp(x), rtol=1e-5)
+    assert_almost_equal(mx.nd.log(a).asnumpy(), np.log(x), rtol=1e-5)
+    assert_almost_equal(mx.nd.square(a).asnumpy(), x ** 2, rtol=1e-5)
+    assert_almost_equal(mx.nd.sum(a).asnumpy(), x.sum(), rtol=1e-5)
+    assert_almost_equal(
+        mx.nd.sum(a, axis=1).asnumpy(), x.sum(axis=1), rtol=1e-5
+    )
+    assert_almost_equal(mx.nd.max(a, axis=0).asnumpy(), x.max(axis=0))
+    assert_almost_equal(
+        mx.nd.transpose(a).asnumpy(), x.T
+    )
+
+
+def test_ndarray_dot():
+    rs = np.random.RandomState(1)
+    x = rs.randn(4, 5).astype(np.float32)
+    y = rs.randn(5, 3).astype(np.float32)
+    res = mx.nd.dot(mx.nd.array(x), mx.nd.array(y))
+    assert_almost_equal(res.asnumpy(), x @ y, rtol=1e-5, atol=1e-5)
+    # transpose flags
+    res2 = mx.nd.dot(mx.nd.array(x), mx.nd.array(y.T), transpose_b=True)
+    assert_almost_equal(res2.asnumpy(), x @ y, rtol=1e-5, atol=1e-5)
+
+
+def test_ndarray_concat_split():
+    x = np.arange(12).reshape(3, 4).astype(np.float32)
+    y = np.arange(12, 24).reshape(3, 4).astype(np.float32)
+    c = mx.nd.concat(mx.nd.array(x), mx.nd.array(y), dim=0)
+    assert same(c.asnumpy(), np.concatenate([x, y], axis=0))
+    parts = mx.nd.split(mx.nd.array(x), num_outputs=2, axis=1)
+    assert same(parts[0].asnumpy(), x[:, :2])
+    assert same(parts[1].asnumpy(), x[:, 2:])
+
+
+def test_ndarray_saveload():
+    with tempfile.TemporaryDirectory() as td:
+        fname = os.path.join(td, "nd.bin")
+        arrays = [mx.nd.array(np.random.rand(3, 4)), mx.nd.ones((2,))]
+        mx.nd.save(fname, arrays)
+        loaded = mx.nd.load(fname)
+        assert len(loaded) == 2
+        for a, b in zip(arrays, loaded):
+            assert same(a.asnumpy(), b.asnumpy())
+        d = {"w": arrays[0], "b": arrays[1]}
+        mx.nd.save(fname, d)
+        loaded_d = mx.nd.load(fname)
+        assert set(loaded_d) == {"w", "b"}
+        assert same(loaded_d["w"].asnumpy(), arrays[0].asnumpy())
+
+
+def test_ndarray_broadcast():
+    a = mx.nd.ones((2, 1, 3))
+    b = a.broadcast_to((2, 4, 3))
+    assert b.shape == (2, 4, 3)
+    assert same(b.asnumpy(), np.ones((2, 4, 3), dtype=np.float32))
+
+
+def test_ndarray_wait():
+    a = mx.nd.ones((10, 10))
+    b = mx.nd.dot(a, a)
+    b.wait_to_read()
+    mx.nd.waitall()
+
+
+def test_ndarray_scalar_semantics():
+    a = mx.nd.array([3.5])
+    assert float(a) == 3.5
+    assert int(a) == 3
+    with pytest.raises(Exception):
+        mx.nd.ones((2,)).asscalar()
+
+
+def test_onehot_encode():
+    ind = mx.nd.array([1, 0, 2])
+    out = mx.nd.zeros((3, 3))
+    mx.nd.onehot_encode(ind, out)
+    assert same(out.asnumpy(), np.eye(3, dtype=np.float32)[[1, 0, 2]])

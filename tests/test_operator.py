@@ -1,0 +1,508 @@
+"""Operator tests with numpy oracles + finite-difference gradient checks
+(reference tests/python/unittest/test_operator.py, 3228 LoC — the central
+numeric test strategy of SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (
+    assert_almost_equal,
+    check_numeric_gradient,
+    check_symbolic_backward,
+    check_symbolic_forward,
+)
+
+rs = np.random.RandomState(7)
+
+
+def test_elemwise_ops_forward_backward():
+    shape = (3, 4)
+    x = rs.randn(*shape).astype(np.float32)
+    y = rs.randn(*shape).astype(np.float32)
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    check_symbolic_forward(a + b, {"a": x, "b": y}, [x + y])
+    check_symbolic_forward(a * b, {"a": x, "b": y}, [x * y])
+    og = rs.randn(*shape).astype(np.float32)
+    check_symbolic_backward(a * b, {"a": x, "b": y}, [og], [og * y, og * x])
+    check_symbolic_backward(a + b, {"a": x, "b": y}, [og], [og, og])
+
+
+def test_unary_math_forward():
+    x = rs.rand(3, 4).astype(np.float32) + 0.5
+    v = mx.sym.Variable("x")
+    cases = {
+        "sqrt": np.sqrt, "exp": np.exp, "log": np.log, "square": np.square,
+        "sin": np.sin, "cos": np.cos, "tanh": np.tanh, "abs": np.abs,
+        "sigmoid": lambda z: 1 / (1 + np.exp(-z)),
+        "relu": lambda z: np.maximum(z, 0),
+        "rsqrt": lambda z: 1 / np.sqrt(z),
+    }
+    for name, np_fn in cases.items():
+        sym = getattr(mx.sym, name)(v)
+        check_symbolic_forward(sym, {"x": x}, [np_fn(x)], rtol=1e-4, atol=1e-5)
+
+
+def test_scalar_ops():
+    x = rs.randn(3, 4).astype(np.float32)
+    v = mx.sym.Variable("x")
+    check_symbolic_forward(v + 3.0, {"x": x}, [x + 3])
+    check_symbolic_forward(3.0 - v, {"x": x}, [3 - x])
+    check_symbolic_forward(v * 0.5, {"x": x}, [x * 0.5])
+    check_symbolic_forward(2.0 / (v + 10.0), {"x": x}, [2 / (x + 10)], rtol=1e-5)
+
+
+def test_fully_connected():
+    x = rs.randn(4, 10).astype(np.float32)
+    w = rs.randn(5, 10).astype(np.float32)
+    b = rs.randn(5).astype(np.float32)
+    fc = mx.sym.FullyConnected(
+        mx.sym.Variable("x"), mx.sym.Variable("w"), mx.sym.Variable("b"),
+        num_hidden=5,
+    )
+    check_symbolic_forward(
+        fc, {"x": x, "w": w, "b": b}, [x @ w.T + b], rtol=1e-4, atol=1e-5
+    )
+    check_numeric_gradient(fc, {"x": x, "w": w, "b": b}, rtol=0.05, atol=1e-2)
+
+
+def test_dot_gradient():
+    x = rs.randn(3, 4).astype(np.float32)
+    y = rs.randn(4, 5).astype(np.float32)
+    d = mx.sym.dot(mx.sym.Variable("x"), mx.sym.Variable("y"))
+    check_numeric_gradient(d, {"x": x, "y": y}, rtol=0.05, atol=1e-2)
+
+
+def test_convolution_forward():
+    # oracle: scipy-free direct conv via numpy
+    x = rs.randn(2, 3, 7, 7).astype(np.float32)
+    w = rs.randn(4, 3, 3, 3).astype(np.float32)
+    b = np.zeros(4, dtype=np.float32)
+    conv = mx.sym.Convolution(
+        mx.sym.Variable("x"), mx.sym.Variable("w"), mx.sym.Variable("b"),
+        kernel=(3, 3), num_filter=4,
+    )
+    out = np.zeros((2, 4, 5, 5), dtype=np.float32)
+    for n in range(2):
+        for f in range(4):
+            for i in range(5):
+                for j in range(5):
+                    out[n, f, i, j] = np.sum(
+                        x[n, :, i:i + 3, j:j + 3] * w[f]
+                    )
+    check_symbolic_forward(
+        conv, {"x": x, "w": w, "b": b}, [out], rtol=1e-3, atol=1e-3
+    )
+
+
+def test_convolution_gradient():
+    x = rs.randn(1, 2, 5, 5).astype(np.float32)
+    w = rs.randn(2, 2, 3, 3).astype(np.float32)
+    b = rs.randn(2).astype(np.float32)
+    conv = mx.sym.Convolution(
+        mx.sym.Variable("x"), mx.sym.Variable("w"), mx.sym.Variable("b"),
+        kernel=(3, 3), num_filter=2, pad=(1, 1),
+    )
+    check_numeric_gradient(
+        conv, {"x": x, "w": w, "b": b}, numeric_eps=1e-2, rtol=0.1, atol=5e-2
+    )
+
+
+def test_deconvolution_shape_and_grad():
+    x = rs.randn(1, 3, 4, 4).astype(np.float32)
+    w = rs.randn(3, 2, 3, 3).astype(np.float32)
+    deconv = mx.sym.Deconvolution(
+        mx.sym.Variable("x"), mx.sym.Variable("w"), kernel=(3, 3),
+        num_filter=2, stride=(2, 2), no_bias=True,
+    )
+    _, out_shapes, _ = deconv.infer_shape(x=(1, 3, 4, 4))
+    # mxnet deconv out = (in-1)*stride + kernel - 2*pad
+    assert out_shapes[0] == (1, 2, 9, 9)
+    check_numeric_gradient(
+        deconv, {"x": x, "w": w}, numeric_eps=1e-2, rtol=0.1, atol=5e-2
+    )
+
+
+def test_deconv_is_conv_transpose():
+    """Deconvolution must be the exact adjoint of Convolution."""
+    x = rs.randn(1, 2, 6, 6).astype(np.float32)
+    w = rs.randn(3, 2, 3, 3).astype(np.float32)  # conv weight (O,I,kh,kw)
+    conv = mx.sym.Convolution(
+        mx.sym.Variable("x"), mx.sym.Variable("w"), kernel=(3, 3),
+        num_filter=3, no_bias=True,
+    )
+    exe = conv.bind(
+        mx.cpu(), args={"x": mx.nd.array(x), "w": mx.nd.array(w)},
+        args_grad={"x": mx.nd.zeros(x.shape), "w": mx.nd.zeros(w.shape)},
+    )
+    exe.forward(is_train=True)
+    og = rs.randn(*exe.outputs[0].shape).astype(np.float32)
+    exe.backward(mx.nd.array(og))
+    dx_conv = exe.grad_dict["x"].asnumpy()
+
+    # deconv forward with swapped weight layout (I→first axis)
+    deconv = mx.sym.Deconvolution(
+        mx.sym.Variable("g"), mx.sym.Variable("w"), kernel=(3, 3),
+        num_filter=2, no_bias=True,
+    )
+    out = mx.test_utils.simple_forward(
+        deconv, g=og, w=np.transpose(w, (0, 1, 2, 3))
+    )
+    assert_almost_equal(out, dx_conv, rtol=1e-3, atol=1e-4)
+
+
+def test_pooling():
+    x = rs.randn(1, 1, 4, 4).astype(np.float32)
+    pool = mx.sym.Pooling(
+        mx.sym.Variable("x"), kernel=(2, 2), stride=(2, 2), pool_type="max"
+    )
+    expected = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    check_symbolic_forward(pool, {"x": x}, [expected])
+    avg = mx.sym.Pooling(
+        mx.sym.Variable("x"), kernel=(2, 2), stride=(2, 2), pool_type="avg"
+    )
+    expected_avg = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    check_symbolic_forward(avg, {"x": x}, [expected_avg], rtol=1e-5)
+    gp = mx.sym.Pooling(mx.sym.Variable("x"), global_pool=True, pool_type="max")
+    check_symbolic_forward(gp, {"x": x}, [x.max(axis=(2, 3), keepdims=True)])
+
+
+def test_batchnorm_train_stats():
+    x = rs.randn(8, 3, 4, 4).astype(np.float32)
+    bn = mx.sym.BatchNorm(mx.sym.Variable("x"), name="bn", fix_gamma=False)
+    exe = bn.simple_bind(ctx=mx.cpu(), x=x.shape)
+    exe.arg_dict["bn_gamma"][:] = 1.0
+    exe.arg_dict["bn_beta"][:] = 0.0
+    exe.forward(is_train=True, x=mx.nd.array(x))
+    out = exe.outputs[0].asnumpy()
+    # normalized output: per-channel mean 0, var 1
+    assert_almost_equal(out.mean(axis=(0, 2, 3)), np.zeros(3), atol=1e-5)
+    assert_almost_equal(out.var(axis=(0, 2, 3)), np.ones(3), rtol=1e-3, atol=1e-3)
+    # moving stats updated with momentum 0.9
+    exe.backward(mx.nd.ones(out.shape))
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    assert_almost_equal(mm, 0.1 * x.mean(axis=(0, 2, 3)), rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_output_grad():
+    x = rs.randn(4, 5).astype(np.float32)
+    label = np.array([0, 1, 2, 3], dtype=np.float32)
+    sm = mx.sym.SoftmaxOutput(
+        mx.sym.Variable("x"), mx.sym.Variable("l"), name="sm"
+    )
+    exe = sm.bind(
+        mx.cpu(), args={"x": mx.nd.array(x), "l": mx.nd.array(label)},
+        args_grad={"x": mx.nd.zeros(x.shape), "l": mx.nd.zeros(label.shape)},
+        grad_req={"x": "write", "l": "null"},
+    )
+    exe.forward(is_train=True)
+    p = exe.outputs[0].asnumpy()
+    expected_p = np.exp(x) / np.exp(x).sum(axis=1, keepdims=True)
+    assert_almost_equal(p, expected_p, rtol=1e-5, atol=1e-6)
+    exe.backward()
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    assert_almost_equal(
+        exe.grad_dict["x"].asnumpy(), p - onehot, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_linear_regression_output():
+    x = rs.randn(4, 3).astype(np.float32)
+    label = rs.randn(4, 3).astype(np.float32)
+    lro = mx.sym.LinearRegressionOutput(
+        mx.sym.Variable("x"), mx.sym.Variable("l")
+    )
+    exe = lro.bind(
+        mx.cpu(), args={"x": mx.nd.array(x), "l": mx.nd.array(label)},
+        args_grad={"x": mx.nd.zeros(x.shape)},
+        grad_req={"x": "write", "l": "null"},
+    )
+    exe.forward(is_train=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), x)
+    exe.backward()
+    assert_almost_equal(
+        exe.grad_dict["x"].asnumpy(), (x - label) / 3.0, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_activation_grads():
+    x = rs.randn(3, 4).astype(np.float32)
+    for act in ["relu", "sigmoid", "tanh", "softrelu"]:
+        sym = mx.sym.Activation(mx.sym.Variable("x"), act_type=act)
+        check_numeric_gradient(sym, {"x": x}, rtol=0.05, atol=1e-2)
+
+
+def test_leaky_relu():
+    x = rs.randn(3, 4).astype(np.float32)
+    leaky = mx.sym.LeakyReLU(mx.sym.Variable("x"), act_type="leaky", slope=0.1)
+    check_symbolic_forward(
+        leaky, {"x": x}, [np.where(x > 0, x, 0.1 * x)], rtol=1e-5
+    )
+    elu = mx.sym.LeakyReLU(mx.sym.Variable("x"), act_type="elu", slope=0.5)
+    check_symbolic_forward(
+        elu, {"x": x}, [np.where(x > 0, x, 0.5 * (np.exp(x) - 1))], rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_embedding():
+    data = np.array([[0, 2], [1, 3]], dtype=np.float32)
+    weight = rs.randn(4, 5).astype(np.float32)
+    emb = mx.sym.Embedding(
+        mx.sym.Variable("data"), mx.sym.Variable("w"),
+        input_dim=4, output_dim=5,
+    )
+    check_symbolic_forward(
+        emb, {"data": data, "w": weight},
+        [weight[data.astype(int)]],
+    )
+
+
+def test_reshape_special_codes():
+    x = rs.randn(2, 3, 4).astype(np.float32)
+    v = mx.sym.Variable("x")
+    assert mx.test_utils.simple_forward(
+        v, x=x
+    ).shape == (2, 3, 4)
+    r1 = mx.sym.Reshape(v, shape=(-1,))
+    assert mx.test_utils.simple_forward(r1, x=x).shape == (24,)
+    r2 = mx.sym.Reshape(v, shape=(0, -1))
+    assert mx.test_utils.simple_forward(r2, x=x).shape == (2, 12)
+    r3 = mx.sym.Reshape(v, shape=(-2,))
+    assert mx.test_utils.simple_forward(r3, x=x).shape == (2, 3, 4)
+    r4 = mx.sym.Reshape(v, shape=(-3, 4))
+    assert mx.test_utils.simple_forward(r4, x=x).shape == (6, 4)
+    r5 = mx.sym.Reshape(v, shape=(-4, 1, 2, 0, 0))
+    assert mx.test_utils.simple_forward(r5, x=x).shape == (1, 2, 3, 4)
+
+
+def test_transpose_swapaxes():
+    x = rs.randn(2, 3, 4).astype(np.float32)
+    v = mx.sym.Variable("x")
+    check_symbolic_forward(mx.sym.transpose(v), {"x": x}, [x.T])
+    check_symbolic_forward(
+        mx.sym.transpose(v, axes=(1, 0, 2)), {"x": x}, [x.transpose(1, 0, 2)]
+    )
+    check_symbolic_forward(
+        mx.sym.SwapAxis(v, dim1=0, dim2=2), {"x": x}, [x.swapaxes(0, 2)]
+    )
+
+
+def test_reductions():
+    x = rs.randn(2, 3, 4).astype(np.float32)
+    v = mx.sym.Variable("x")
+    check_symbolic_forward(mx.sym.sum(v), {"x": x}, [x.sum()], rtol=1e-5)
+    check_symbolic_forward(
+        mx.sym.sum(v, axis=1), {"x": x}, [x.sum(axis=1)], rtol=1e-5
+    )
+    check_symbolic_forward(
+        mx.sym.sum(v, axis=(0, 2), keepdims=True), {"x": x},
+        [x.sum(axis=(0, 2), keepdims=True)], rtol=1e-5,
+    )
+    check_symbolic_forward(
+        mx.sym.sum(v, axis=1, exclude=True), {"x": x},
+        [x.sum(axis=(0, 2))], rtol=1e-5,
+    )
+    check_symbolic_forward(mx.sym.mean(v, axis=0), {"x": x}, [x.mean(axis=0)], rtol=1e-5)
+    check_symbolic_forward(mx.sym.max(v, axis=2), {"x": x}, [x.max(axis=2)])
+    check_symbolic_forward(
+        mx.sym.argmax(v, axis=1), {"x": x},
+        [x.argmax(axis=1).astype(np.float32)],
+    )
+
+
+def test_slice_ops():
+    x = rs.randn(4, 6).astype(np.float32)
+    v = mx.sym.Variable("x")
+    check_symbolic_forward(
+        mx.sym.slice(v, begin=(1, 2), end=(3, 5)), {"x": x}, [x[1:3, 2:5]]
+    )
+    check_symbolic_forward(
+        mx.sym.slice_axis(v, axis=1, begin=1, end=4), {"x": x}, [x[:, 1:4]]
+    )
+    check_symbolic_forward(
+        mx.sym.slice_axis(v, axis=0, begin=-2, end=None), {"x": x}, [x[-2:]]
+    )
+
+
+def test_concat_backward():
+    x = rs.randn(2, 3).astype(np.float32)
+    y = rs.randn(2, 4).astype(np.float32)
+    c = mx.sym.Concat(mx.sym.Variable("x"), mx.sym.Variable("y"), dim=1)
+    og = rs.randn(2, 7).astype(np.float32)
+    check_symbolic_forward(
+        c, {"x": x, "y": y}, [np.concatenate([x, y], axis=1)]
+    )
+    check_symbolic_backward(
+        c, {"x": x, "y": y}, [og], [og[:, :3], og[:, 3:]]
+    )
+
+
+def test_dropout_train_eval():
+    x = np.ones((100, 100), dtype=np.float32)
+    do = mx.sym.Dropout(mx.sym.Variable("x"), p=0.5)
+    exe = do.bind(mx.cpu(), args={"x": mx.nd.array(x)})
+    exe.forward(is_train=False)
+    assert_almost_equal(exe.outputs[0].asnumpy(), x)  # identity in eval
+    exe.forward(is_train=True)
+    out = exe.outputs[0].asnumpy()
+    kept = (out != 0).mean()
+    assert 0.4 < kept < 0.6  # ~half kept
+    assert_almost_equal(out[out != 0], 2.0 * x[out != 0])  # scaled by 1/(1-p)
+
+
+def test_block_grad():
+    x = rs.randn(3, 4).astype(np.float32)
+    sym = mx.sym.BlockGrad(mx.sym.Variable("x") * 2.0)
+    check_symbolic_backward(
+        sym, {"x": x}, [np.ones((3, 4), dtype=np.float32)],
+        [np.zeros((3, 4), dtype=np.float32)],
+    )
+
+
+def test_where():
+    cond = np.array([[1, 0], [0, 1]], dtype=np.float32)
+    x = np.array([[1, 2], [3, 4]], dtype=np.float32)
+    y = np.array([[5, 6], [7, 8]], dtype=np.float32)
+    w = mx.sym.where(
+        mx.sym.Variable("c"), mx.sym.Variable("x"), mx.sym.Variable("y")
+    )
+    check_symbolic_forward(
+        w, {"c": cond, "x": x, "y": y}, [np.where(cond != 0, x, y)]
+    )
+
+
+def test_clip_take_onehot_pick():
+    x = rs.randn(3, 4).astype(np.float32)
+    check_symbolic_forward(
+        mx.sym.clip(mx.sym.Variable("x"), a_min=-0.5, a_max=0.5),
+        {"x": x}, [np.clip(x, -0.5, 0.5)],
+    )
+    data = rs.randn(5, 4).astype(np.float32)
+    idx = np.array([0, 2, 4], dtype=np.float32)
+    check_symbolic_forward(
+        mx.sym.take(mx.sym.Variable("d"), mx.sym.Variable("i")),
+        {"d": data, "i": idx}, [data[idx.astype(int)]],
+    )
+    check_symbolic_forward(
+        mx.sym.one_hot(mx.sym.Variable("i"), depth=5),
+        {"i": idx}, [np.eye(5, dtype=np.float32)[idx.astype(int)]],
+    )
+    picked = mx.sym.pick(mx.sym.Variable("x"), mx.sym.Variable("i"), axis=1)
+    pidx = np.array([0, 1, 3], dtype=np.float32)
+    check_symbolic_forward(
+        picked, {"x": x, "i": pidx},
+        [x[np.arange(3), pidx.astype(int)]],
+    )
+
+
+def test_sequence_ops():
+    x = rs.randn(4, 3, 2).astype(np.float32)  # (seq, batch, feat)
+    seqlen = np.array([2, 4, 1], dtype=np.float32)
+    last = mx.sym.SequenceLast(
+        mx.sym.Variable("x"), mx.sym.Variable("sl"), use_sequence_length=True
+    )
+    expected = np.stack([x[1, 0], x[3, 1], x[0, 2]])
+    check_symbolic_forward(last, {"x": x, "sl": seqlen}, [expected])
+    mask = mx.sym.SequenceMask(
+        mx.sym.Variable("x"), mx.sym.Variable("sl"), use_sequence_length=True,
+        value=-1.0,
+    )
+    exp_mask = x.copy()
+    exp_mask[2:, 0] = -1.0
+    exp_mask[1:, 2] = -1.0
+    check_symbolic_forward(mask, {"x": x, "sl": seqlen}, [exp_mask])
+
+
+def test_lrn():
+    x = rs.rand(2, 8, 3, 3).astype(np.float32)
+    lrn = mx.sym.LRN(mx.sym.Variable("x"), nsize=5, alpha=1e-4, beta=0.75, knorm=2.0)
+    # numpy oracle
+    sq = x ** 2
+    out = np.zeros_like(x)
+    for c in range(8):
+        lo, hi = max(0, c - 2), min(8, c + 3)
+        norm = 2.0 + (1e-4 / 5) * sq[:, lo:hi].sum(axis=1)
+        out[:, c] = x[:, c] * norm ** -0.75
+    check_symbolic_forward(lrn, {"x": x}, [out], rtol=1e-4, atol=1e-5)
+
+
+def test_upsampling_nearest():
+    x = rs.randn(1, 2, 3, 3).astype(np.float32)
+    up = mx.sym.UpSampling(
+        mx.sym.Variable("x"), scale=2, sample_type="nearest"
+    )
+    expected = x.repeat(2, axis=2).repeat(2, axis=3)
+    check_symbolic_forward(up, {"x": x}, [expected])
+
+
+def test_l2_normalization():
+    x = rs.randn(3, 4).astype(np.float32)
+    l2 = mx.sym.L2Normalization(mx.sym.Variable("x"), mode="instance")
+    norm = np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    check_symbolic_forward(l2, {"x": x}, [x / norm], rtol=1e-5)
+
+
+def test_softmax_log_softmax():
+    x = rs.randn(3, 5).astype(np.float32)
+    sm = np.exp(x) / np.exp(x).sum(axis=1, keepdims=True)
+    check_symbolic_forward(
+        mx.sym.softmax(mx.sym.Variable("x")), {"x": x}, [sm], rtol=1e-5,
+        atol=1e-6,
+    )
+    check_symbolic_forward(
+        mx.sym.log_softmax(mx.sym.Variable("x")), {"x": x}, [np.log(sm)],
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_optimizer_kernels():
+    w = rs.randn(5).astype(np.float32)
+    g = rs.randn(5).astype(np.float32)
+    out = mx.nd.sgd_update(mx.nd.array(w), mx.nd.array(g), lr=0.1, wd=0.01)
+    assert_almost_equal(
+        out.asnumpy(), w - 0.1 * (g + 0.01 * w), rtol=1e-5, atol=1e-6
+    )
+    # momentum
+    mom = np.zeros(5, dtype=np.float32)
+    wn, mn = mx.nd.array(w), mx.nd.array(mom)
+    mx.nd.sgd_mom_update(wn, mx.nd.array(g), mn, out=wn, lr=0.1, momentum=0.9)
+    assert_almost_equal(mn.asnumpy(), -0.1 * g, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(wn.asnumpy(), w - 0.1 * g, rtol=1e-5, atol=1e-6)
+
+
+def test_grad_req_add():
+    x = rs.randn(3,).astype(np.float32)
+    sym = mx.sym.square(mx.sym.Variable("x"))
+    grad = mx.nd.array(np.ones(3, dtype=np.float32))
+    exe = sym.bind(
+        mx.cpu(), args={"x": mx.nd.array(x)}, args_grad={"x": grad},
+        grad_req="add",
+    )
+    exe.forward(is_train=True)
+    exe.backward(mx.nd.ones((3,)))
+    assert_almost_equal(grad.asnumpy(), 1 + 2 * x, rtol=1e-5)
+    exe.forward(is_train=True)
+    exe.backward(mx.nd.ones((3,)))
+    assert_almost_equal(grad.asnumpy(), 1 + 4 * x, rtol=1e-5)
+
+
+def test_batch_dot():
+    x = rs.randn(3, 2, 4).astype(np.float32)
+    y = rs.randn(3, 4, 5).astype(np.float32)
+    bd = mx.sym.batch_dot(mx.sym.Variable("x"), mx.sym.Variable("y"))
+    check_symbolic_forward(
+        bd, {"x": x, "y": y}, [np.matmul(x, y)], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_topk_sort():
+    x = rs.randn(3, 6).astype(np.float32)
+    v = mx.sym.Variable("x")
+    check_symbolic_forward(
+        mx.sym.sort(v, axis=1), {"x": x}, [np.sort(x, axis=1)]
+    )
+    out = mx.test_utils.simple_forward(mx.sym.topk(v, axis=1, k=2, ret_typ="value"), x=x)
+    expected = np.sort(x, axis=1)[:, ::-1][:, :2]
+    assert_almost_equal(out, expected)

@@ -1,0 +1,128 @@
+"""Optimizer tests (reference test_optimizer.py): each optimizer against a
+numpy reference implementation."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+rs = np.random.RandomState(9)
+
+
+def _run_updates(opt, w0, g_seq):
+    w = mx.nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    for g in g_seq:
+        opt.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = rs.randn(10).astype(np.float32)
+    gs = [rs.randn(10).astype(np.float32) for _ in range(5)]
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.01, rescale_grad=1.0)
+    got = _run_updates(opt, w0, gs)
+    w = w0.copy()
+    for g in gs:
+        w = w - 0.1 * (g + 0.01 * w)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_matches_numpy():
+    w0 = rs.randn(10).astype(np.float32)
+    gs = [rs.randn(10).astype(np.float32) for _ in range(5)]
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    got = _run_updates(opt, w0, gs)
+    w, mom = w0.copy(), np.zeros_like(w0)
+    for g in gs:
+        mom = 0.9 * mom - 0.1 * g
+        w = w + mom
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w0 = rs.randn(10).astype(np.float32)
+    gs = [rs.randn(10).astype(np.float32) for _ in range(5)]
+    opt = mx.optimizer.Adam(learning_rate=0.01, rescale_grad=1.0)
+    got = _run_updates(opt, w0, gs)
+    w = w0.astype(np.float64).copy()
+    m, v = np.zeros_like(w), np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(gs, 1):
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g ** 2
+        w = w - lr * m / (np.sqrt(v) + eps)
+    assert_almost_equal(got, w.astype(np.float32), rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop_matches_numpy():
+    w0 = rs.randn(10).astype(np.float32)
+    gs = [rs.randn(10).astype(np.float32) for _ in range(5)]
+    opt = mx.optimizer.RMSProp(learning_rate=0.01, gamma1=0.9, rescale_grad=1.0)
+    got = _run_updates(opt, w0, gs)
+    w = w0.astype(np.float64).copy()
+    n = np.zeros_like(w)
+    for g in gs:
+        n = 0.1 * g ** 2 + 0.9 * n
+        w = w - 0.01 * g / np.sqrt(n + 1e-8)
+    assert_almost_equal(got, w.astype(np.float32), rtol=1e-4, atol=1e-5)
+
+
+def test_clip_gradient():
+    w0 = np.zeros(3, dtype=np.float32)
+    g = np.array([10.0, -10.0, 0.5], dtype=np.float32)
+    opt = mx.optimizer.SGD(learning_rate=1.0, clip_gradient=1.0, rescale_grad=1.0)
+    got = _run_updates(opt, w0, [g])
+    assert_almost_equal(got, -np.clip(g, -1, 1), rtol=1e-6)
+
+
+def test_lr_scheduler_factor():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched, rescale_grad=1.0)
+    w = mx.nd.zeros((1,))
+    for i in range(25):
+        opt.update(0, w, mx.nd.ones((1,)), None)
+    assert sched.base_lr == 0.25  # two decays
+
+
+def test_lr_wd_mult():
+    opt = mx.optimizer.SGD(
+        learning_rate=0.1, param_idx2name={0: "fc_weight", 1: "fc_bias"},
+        wd=0.1, rescale_grad=1.0,
+    )
+    opt.set_lr_mult({"fc_weight": 0.0})
+    w = mx.nd.ones((2,))
+    before = w.asnumpy().copy()
+    opt.update(0, w, mx.nd.ones((2,)), opt.create_state(0, w))
+    assert_almost_equal(w.asnumpy(), before)  # lr 0 → no change
+    # bias gets wd_mult=0 automatically (name doesn't end in _weight/_gamma)
+    b = mx.nd.ones((2,))
+    opt.update(1, b, mx.nd.zeros((2,)), opt.create_state(1, b))
+    assert_almost_equal(b.asnumpy(), np.ones(2))  # zero grad + no wd → no change
+
+
+def test_updater_states_serialization():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    updater = mx.optimizer.get_updater(opt)
+    w = mx.nd.ones((4,))
+    updater(0, mx.nd.ones((4,)), w)
+    blob = updater.get_states()
+    updater2 = mx.optimizer.get_updater(
+        mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    )
+    updater2.set_states(blob)
+    assert 0 in updater2.states
+    assert_almost_equal(
+        updater2.states[0].asnumpy(), updater.states[0].asnumpy()
+    )
+
+
+def test_create_by_name():
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "nag",
+                 "sgld", "ftrl", "dcasgd", "test"]:
+        opt = mx.optimizer.create(name)
+        assert isinstance(opt, mx.optimizer.Optimizer)
+    with pytest.raises(ValueError):
+        mx.optimizer.create("nonexistent_optimizer")

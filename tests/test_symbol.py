@@ -1,0 +1,147 @@
+"""Symbol tests (reference test_symbol.py, test_attr.py, test_infer_shape.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=10)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=4)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_compose_and_listing():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label",
+    ]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.name == "softmax"
+
+
+def test_auto_naming():
+    with mx.name.NameManager():
+        a = mx.sym.FullyConnected(mx.sym.Variable("x"), num_hidden=3)
+        b = mx.sym.FullyConnected(a, num_hidden=3)
+        assert a.name == "fullyconnected0"
+        assert b.name == "fullyconnected1"
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 20))
+    assert arg_shapes == [(8, 20), (10, 20), (10,), (4, 10), (4,), (8,)]
+    assert out_shapes == [(8, 4)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_partial():
+    net = _mlp()
+    arg_shapes, out_shapes, _ = net.infer_shape_partial()
+    assert arg_shapes[0] is None
+    # conv tower partial: only data known halfway
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=2)
+    arg_shapes, _, _ = conv.infer_shape(data=(1, 3, 8, 8))
+    assert arg_shapes[1] == (2, 3, 3, 3)
+
+
+def test_infer_type():
+    net = _mlp()
+    arg_types, out_types, _ = net.infer_type(data="float32")
+    assert all(t == np.float32 for t in arg_types)
+    assert out_types[0] == np.float32
+
+
+def test_symbol_group_and_index():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    g = mx.sym.Group([a + b, a * b])
+    assert len(g.list_outputs()) == 2
+    first = g[0]
+    assert len(first.list_outputs()) == 1
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs
+    assert "data" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = mx.sym.fromjson(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    assert net2.tojson() == js
+    with tempfile.TemporaryDirectory() as td:
+        fname = os.path.join(td, "sym.json")
+        net.save(fname)
+        net3 = mx.sym.load(fname)
+        assert net3.list_arguments() == net.list_arguments()
+
+
+def test_symbol_attrs():
+    data = mx.sym.Variable("data", lr_mult=2.0)
+    with mx.AttrScope(ctx_group="dev1"):
+        fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    assert fc.attr("ctx_group") == "dev1"
+    attrs = fc.attr_dict()
+    assert attrs["data"]["__lr_mult__"] == "2.0"
+    assert attrs["fc"]["ctx_group"] == "dev1"
+
+
+def test_variable_shape_attr():
+    v = mx.sym.Variable("x", shape=(3, 4))
+    fc = mx.sym.FullyConnected(v, num_hidden=2)
+    arg_shapes, out_shapes, _ = fc.infer_shape()
+    assert arg_shapes[0] == (3, 4)
+    assert out_shapes[0] == (3, 2)
+
+
+def test_multi_output_indexing():
+    x = mx.sym.Variable("x")
+    parts = mx.sym.SliceChannel(x, num_outputs=3, name="split")
+    assert len(parts.list_outputs()) == 3
+    p1 = parts[1]
+    out = p1.eval(ctx=mx.cpu(), x=mx.nd.array(np.arange(9).reshape(1, 9)))
+    assert out[0].shape == (1, 3)
+    np.testing.assert_allclose(out[0].asnumpy(), [[3, 4, 5]])
+
+
+def test_infer_shape_mismatch_raises():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = fc + mx.sym.FullyConnected(data, num_hidden=4, name="fc2")
+    with pytest.raises(MXNetError):
+        net.infer_shape(data=(2, 5))
+
+
+def test_arithmetic_sugar():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    x = np.array([2.0, 4.0], dtype=np.float32)
+    y = np.array([3.0, 5.0], dtype=np.float32)
+    for sym, expected in [
+        (a + b, x + y), (a - b, x - y), (a * b, x * y), (a / b, x / y),
+        (a + 1.0, x + 1), (2.0 * a, 2 * x), (1.0 / a, 1 / x), (a ** 2.0, x ** 2),
+        (a > b, (x > y).astype(np.float32)),
+        (a <= b, (x <= y).astype(np.float32)),
+    ]:
+        exe = sym.bind(mx.cpu(), args={"a": mx.nd.array(x), "b": mx.nd.array(y)} if "b" in sym.list_arguments() else {"a": mx.nd.array(x)})
+        exe.forward()
+        np.testing.assert_allclose(exe.outputs[0].asnumpy(), expected, rtol=1e-6)
