@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Bucketed LSTM language model (reference example/rnn/lstm_bucketing.py on
+PTB). Reads PTB-format text files if present; otherwise --synthetic 1 trains
+on generated sequences (this environment has no egress to fetch PTB)."""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    with open(fname) as f:
+        lines = f.readlines()
+    lines = [l.split() for l in lines]
+    sentences, vocab = mx.rnn.encode_sentences(
+        lines, vocab=vocab, invalid_label=invalid_label,
+        start_label=start_label,
+    )
+    return sentences, vocab
+
+
+def synthetic_corpus(vocab_size, n=2000, seed=0):
+    rs = np.random.RandomState(seed)
+    sents = []
+    for _ in range(n):
+        L = rs.choice([8, 16, 24, 32])
+        start = rs.randint(1, vocab_size - 1)
+        step = rs.choice([1, 2])
+        sents.append([(start + step * i) % (vocab_size - 1) + 1 for i in range(L)])
+    return sents
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data", type=str, default="data/ptb.train.txt")
+    parser.add_argument("--synthetic", type=int, default=0)
+    parser.add_argument("--num-hidden", type=int, default=200)
+    parser.add_argument("--num-embed", type=int, default=200)
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--vocab-size", type=int, default=200)
+    parser.add_argument("--buckets", type=str, default="8,16,24,32")
+    parser.add_argument("--disp-batches", type=int, default=50)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    buckets = [int(b) for b in args.buckets.split(",")]
+    invalid_label = 0
+
+    if args.synthetic or not os.path.exists(args.data):
+        sentences = synthetic_corpus(args.vocab_size)
+        vocab_size = args.vocab_size
+    else:
+        sentences, vocab = tokenize_text(
+            args.data, start_label=1, invalid_label=invalid_label
+        )
+        vocab_size = len(vocab) + 1
+
+    data_train = mx.rnn.BucketSentenceIter(
+        sentences, args.batch_size, buckets=buckets,
+        invalid_label=invalid_label,
+    )
+
+    sym_gen, state_names = models.lstm_lm_sym_gen(
+        num_hidden=args.num_hidden, num_layers=args.num_layers,
+        num_embed=args.num_embed, vocab_size=vocab_size,
+    )
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen, default_bucket_key=data_train.default_bucket_key,
+        state_names=state_names,
+        context=mx.gpu() if mx.num_gpus() else mx.cpu(),
+    )
+    model.fit(
+        train_data=data_train,
+        eval_metric=mx.metric.Perplexity(invalid_label),
+        optimizer="adam",
+        optimizer_params={"learning_rate": args.lr},
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(
+            args.batch_size, args.disp_batches
+        ),
+    )

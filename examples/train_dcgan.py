@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""DCGAN training (reference example/gan/dcgan.py): two Modules trained
+adversarially — D on real+fake, G through D's input gradients."""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def facc(label, pred):
+    pred = pred.ravel()
+    label = label.ravel()
+    return ((pred > 0.5) == label).mean()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--z-dim", type=int, default=100)
+    parser.add_argument("--ngf", type=int, default=64)
+    parser.add_argument("--ndf", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=0.0002)
+    parser.add_argument("--beta1", type=float, default=0.5)
+    parser.add_argument("--num-batches", type=int, default=50,
+                        help="batches/epoch of synthetic 'real' data")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.gpu() if mx.num_gpus() else mx.cpu()
+    bs, Z = args.batch_size, args.z_dim
+
+    gen = models.dcgan_generator(ngf=args.ngf, nc=3)
+    disc = models.dcgan_discriminator(ndf=args.ndf)
+
+    mod_g = mx.mod.Module(gen, data_names=("rand",), label_names=None, context=ctx)
+    mod_g.bind(data_shapes=[("rand", (bs, Z, 1, 1))])
+    mod_g.init_params(initializer=mx.init.Normal(0.02))
+    mod_g.init_optimizer(
+        optimizer="adam",
+        optimizer_params={"learning_rate": args.lr, "beta1": args.beta1},
+    )
+
+    mod_d = mx.mod.Module(disc, data_names=("data",), label_names=("label",),
+                          context=ctx)
+    mod_d.bind(
+        data_shapes=[("data", (bs, 3, 64, 64))],
+        label_shapes=[("label", (bs,))], inputs_need_grad=True,
+    )
+    mod_d.init_params(initializer=mx.init.Normal(0.02))
+    mod_d.init_optimizer(
+        optimizer="adam",
+        optimizer_params={"learning_rate": args.lr, "beta1": args.beta1},
+    )
+
+    metric_acc = mx.metric.CustomMetric(facc)
+    rs = np.random.RandomState(0)
+
+    for epoch in range(args.num_epochs):
+        metric_acc.reset()
+        for t in range(args.num_batches):
+            real = mx.nd.array(
+                rs.rand(bs, 3, 64, 64).astype(np.float32) * 2 - 1
+            )
+            noise = mx.nd.array(rs.randn(bs, Z, 1, 1).astype(np.float32))
+
+            # generate
+            mod_g.forward(mx.io.DataBatch(data=[noise], label=None), is_train=True)
+            fake = mod_g.get_outputs()[0]
+
+            # update D: fake(0) + real(1)
+            mod_d.forward(
+                mx.io.DataBatch(data=[fake], label=[mx.nd.zeros((bs,))]),
+                is_train=True,
+            )
+            mod_d.backward()
+            grads_fake = [
+                [g.copy() for g in gl] for gl in
+                (mod_d._exec_group.grad_arrays,)
+            ][0]
+            mod_d.forward(
+                mx.io.DataBatch(data=[real], label=[mx.nd.ones((bs,))]),
+                is_train=True,
+            )
+            mod_d.backward()
+            # accumulate fake grads (reference adds the two D passes)
+            for gl, gf in zip(mod_d._exec_group.grad_arrays, grads_fake):
+                if gl[0] is not None:
+                    gl[0] += gf[0]
+            mod_d.update()
+            metric_acc.update([mx.nd.ones((bs,))], mod_d.get_outputs())
+
+            # update G via D's input gradients at label=1
+            mod_d.forward(
+                mx.io.DataBatch(data=[fake], label=[mx.nd.ones((bs,))]),
+                is_train=True,
+            )
+            mod_d.backward()
+            diff_d = mod_d.get_input_grads()
+            mod_g.backward(diff_d)
+            mod_g.update()
+
+        name, acc = metric_acc.get()
+        logging.info("epoch %d: D real-acc %.3f", epoch, acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -53,3 +53,6 @@ from . import visualization as viz
 from . import test_utils
 from . import contrib
 from . import parallel
+from . import operator
+from . import predictor
+from . import rtc

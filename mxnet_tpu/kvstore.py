@@ -143,9 +143,21 @@ class DistKVStore(KVStore):
 
     def __init__(self, kv_type):
         super().__init__(kv_type)
+        import os
+
         import jax
 
         self._jax = jax
+        # rendezvous: tools/launch.py sets MXNET_COORDINATOR/NUM_PROCS/PROC_ID
+        # (the analogue of ps-lite's DMLC_* env rendezvous, MXInitPSEnv)
+        coord = os.environ.get("MXNET_COORDINATOR")
+        nproc = int(os.environ.get("MXNET_NUM_PROCS", "1"))
+        if coord and nproc > 1 and jax.process_count() == 1:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=nproc,
+                process_id=int(os.environ["MXNET_PROC_ID"]),
+            )
         if "async" in kv_type:
             import logging
 

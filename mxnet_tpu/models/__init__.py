@@ -16,3 +16,4 @@ from .inception_bn import get_symbol as inception_bn
 from .dcgan import make_generator as dcgan_generator
 from .dcgan import make_discriminator as dcgan_discriminator
 from .lstm_lm import lstm_lm_sym_gen
+from . import ssd

@@ -1,0 +1,119 @@
+"""Custom operators written in the frontend.
+
+Reference: ``python/mxnet/operator.py:396-577`` (``CustomOp``,
+``CustomOpProp``, ``register``) backed by ``src/operator/custom/custom.cc``
+(C++ trampoline calling registered python callbacks, async ExecType::kAsync).
+
+TPU-native: the python callbacks run via ``jax.pure_callback`` from inside
+the jitted graph — the XLA program calls back into the host for exactly the
+custom region and stays fused elsewhere. Gradients route through
+``jax.custom_vjp`` into the user's ``backward``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array, zeros
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for operators implemented in python (reference CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise ValueError(f"unknown req {req}")
+
+
+class CustomOpProp:
+    """Operator property: shapes, types, operator factory (reference
+    CustomOpProp). ``need_top_grad=False`` marks a loss op whose backward
+    ignores the incoming head gradient."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (
+            in_type,
+            [in_type[0]] * len(self.list_outputs()),
+            [in_type[0]] * len(self.list_auxiliary_states()),
+        )
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+    @property
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under ``op_type=reg_name``."""
+
+    def do_register(prop_cls):
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_prop_cls(op_type):
+    if op_type not in _CUSTOM_REGISTRY:
+        raise MXNetError(
+            f"Custom op {op_type!r} is not registered; candidates: "
+            f"{sorted(_CUSTOM_REGISTRY)}"
+        )
+    return _CUSTOM_REGISTRY[op_type]
+
+
+def make_prop(op_type, kwargs):
+    """Instantiate the prop with string kwargs (reference passes strings)."""
+    cls = get_prop_cls(op_type)
+    return cls(**{k: str(v) for k, v in kwargs.items()})
+
+
+# Deprecated V1 interfaces kept as names for import parity
+class NDArrayOp:
+    def __init__(self, *a, **k):
+        raise MXNetError("NDArrayOp is deprecated; use CustomOp")
+
+
+class NumpyOp:
+    def __init__(self, *a, **k):
+        raise MXNetError("NumpyOp is deprecated; use CustomOp")

@@ -10,3 +10,7 @@ from . import defs_reduce  # noqa: F401
 from . import defs_nn  # noqa: F401
 from . import defs_random  # noqa: F401
 from . import defs_optimizer  # noqa: F401
+from . import defs_contrib  # noqa: F401
+from . import defs_rnn  # noqa: F401
+from . import defs_vision  # noqa: F401
+from . import defs_custom  # noqa: F401

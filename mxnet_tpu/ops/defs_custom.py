@@ -1,0 +1,147 @@
+"""The ``Custom`` operator — bridges registered CustomOpProp classes into the
+graph (reference ``src/operator/custom/custom.cc`` registration of op
+"Custom" with ``op_type`` attr).
+
+Runs the user's python ``forward``/``backward`` via ``jax.pure_callback``
+inside the jitted computation, with ``jax.custom_vjp`` routing gradients to
+the user's ``backward``. A custom op therefore costs one host round-trip per
+execution while the rest of the graph stays fused — the analogue of the
+reference's async CustomOp engine dispatch (ExecType::kAsync).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+from .registry import OpDef, _OPS
+
+
+class _CustomOpDef(OpDef):
+    def __init__(self):
+        super().__init__("Custom", self._run, arg_names=[])
+
+    # --- dynamic introspection from the registered prop -------------------
+    def _prop(self, params):
+        from .. import operator as op_mod
+
+        kwargs = {k: v for k, v in params.items() if k != "op_type"}
+        return op_mod.make_prop(params["op_type"], kwargs)
+
+    def parse_params(self, raw):
+        if "op_type" not in raw:
+            raise MXNetError("Custom op requires op_type")
+        return {
+            k: v for k, v in raw.items()
+            if not (k.startswith("__") and k.endswith("__"))
+        }
+
+    def arg_names(self, params):
+        return list(self._prop(params).list_arguments())
+
+    def aux_names(self, params):
+        return list(self._prop(params).list_auxiliary_states())
+
+    def num_outputs(self, params):
+        return len(self._prop(params).list_outputs())
+
+    def num_visible_outputs(self, params):
+        return self.num_outputs(params)
+
+    def infer_shape(self, in_shapes, params, in_dtypes=None):
+        prop = self._prop(params)
+        n_args = len(prop.list_arguments())
+        res = prop.infer_shape([list(s) if s else s for s in in_shapes[:n_args]])
+        arg_shapes, out_shapes, aux_shapes = res
+        return (
+            [tuple(s) for s in arg_shapes],
+            [tuple(s) for s in out_shapes],
+            [tuple(s) for s in aux_shapes],
+        )
+
+    def infer_dtype(self, in_dtypes, params):
+        prop = self._prop(params)
+        filled = [d if d is not None else np.float32 for d in in_dtypes]
+        n_args = len(prop.list_arguments())
+        arg_t, out_t, aux_t = prop.infer_type(filled[:n_args])
+        return (
+            [np_dtype(d) for d in arg_t],
+            [np_dtype(d) for d in out_t],
+            [np_dtype(d) for d in aux_t],
+        )
+
+    # --- execution --------------------------------------------------------
+    def _run(self, ins, params, mode):
+        import jax
+
+        from ..context import cpu
+        from ..ndarray import NDArray
+
+        prop = self._prop(params)
+        arg_names = prop.list_arguments()
+        n_args = len(arg_names)
+        in_shapes = [tuple(x.shape) for x in ins[:n_args]]
+        in_dtypes = [np_dtype(x.dtype) for x in ins[:n_args]]
+        _, out_shapes, _ = self.infer_shape(in_shapes, params)
+        _, out_dtypes, _ = self.infer_dtype(in_dtypes, params)
+        out_struct = [
+            jax.ShapeDtypeStruct(s, d) for s, d in zip(out_shapes, out_dtypes)
+        ]
+        is_train = mode.is_train
+
+        def host_forward(*arrays):
+            op = prop.create_operator(cpu(), in_shapes, in_dtypes)
+            in_nd = [NDArray(jax.numpy.asarray(a)) for a in arrays]
+            out_nd = [
+                NDArray(jax.numpy.zeros(s, d))
+                for s, d in zip(out_shapes, out_dtypes)
+            ]
+            op.forward(is_train, ["write"] * len(out_nd), in_nd, out_nd, [])
+            return tuple(np.asarray(o.asnumpy()) for o in out_nd)
+
+        def host_backward(*arrays):
+            # arrays = out_grads + in_data + out_data
+            og = arrays[: len(out_shapes)]
+            ind = arrays[len(out_shapes):len(out_shapes) + n_args]
+            outd = arrays[len(out_shapes) + n_args:]
+            op = prop.create_operator(cpu(), in_shapes, in_dtypes)
+            og_nd = [NDArray(jax.numpy.asarray(a)) for a in og]
+            in_nd = [NDArray(jax.numpy.asarray(a)) for a in ind]
+            out_nd = [NDArray(jax.numpy.asarray(a)) for a in outd]
+            grad_nd = [
+                NDArray(jax.numpy.zeros(s, d))
+                for s, d in zip(in_shapes, in_dtypes)
+            ]
+            op.backward(
+                ["write"] * n_args, og_nd, in_nd, out_nd, grad_nd, []
+            )
+            return tuple(np.asarray(g.asnumpy()) for g in grad_nd)
+
+        @jax.custom_vjp
+        def f(*args):
+            outs = jax.pure_callback(host_forward, tuple(out_struct), *args)
+            return outs
+
+        def fwd(*args):
+            outs = jax.pure_callback(host_forward, tuple(out_struct), *args)
+            return outs, (args, outs)
+
+        def bwd(res, gs):
+            args, outs = res
+            in_struct = tuple(
+                jax.ShapeDtypeStruct(s, d)
+                for s, d in zip(in_shapes, in_dtypes)
+            )
+            grads = jax.pure_callback(
+                host_backward, in_struct, *(tuple(gs) + tuple(args) + tuple(outs))
+            )
+            return tuple(grads)
+
+        f.defvjp(fwd, bwd)
+        outs = f(*ins[:n_args])
+        return list(outs), []
+
+
+_custom = _CustomOpDef()
+_OPS["Custom"] = _custom
+_OPS["_custom"] = _custom

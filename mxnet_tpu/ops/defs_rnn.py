@@ -1,0 +1,235 @@
+"""Fused multi-layer RNN operator.
+
+Reference: ``src/operator/rnn.cc`` + ``cudnn_rnn-inl.h`` — the cuDNN fused
+RNN consuming one flat parameter blob, used by ``FusedRNNCell``
+(rnn_cell.py:515). TPU-native: the time loop is a ``lax.scan`` (one compiled
+step body, sequential-in-time like the hardware requires), layers unrolled in
+python. The parameter blob layout matches ``FusedRNNCell._slice_weights`` so
+checkpoints interconvert with the unfused cells exactly like the reference.
+
+Inputs: data (T, N, C), parameters (flat,), state (L*D, N, H)
+[, state_cell (L*D, N, H) for lstm]. Outputs: out (T, N, H*D)
+[, final state, final cell when state_outputs=1].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, parse_bool, parse_float, parse_int, parse_str
+from .registry import Param, register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _rnn_param_size(mode, num_layers, bidirectional, input_size, state_size):
+    m = _GATES[mode]
+    b = 2 if bidirectional else 1
+    h = state_size
+    size = 0
+    for layer in range(num_layers):
+        li = input_size if layer == 0 else h * b
+        size += b * (m * h * li + m * h * h)  # i2h + h2h weights
+    size += num_layers * b * (2 * m * h)  # biases
+    return size
+
+
+def _slice_rnn_params(arr, mode, num_layers, bidirectional, input_size, h):
+    """Mirror FusedRNNCell._slice_weights: weights (all layers/dirs), then
+    biases. Returns per (layer, dir): (Wi (m*h, li), Wh (m*h, h), bi, bh)."""
+    m = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    out = []
+    p = 0
+    for layer in range(num_layers):
+        li = input_size if layer == 0 else h * dirs
+        per_dir = []
+        for d in range(dirs):
+            wi = arr[p:p + m * h * li].reshape(m * h, li)
+            p += m * h * li
+            wh = arr[p:p + m * h * h].reshape(m * h, h)
+            p += m * h * h
+            per_dir.append([wi, wh, None, None])
+        out.append(per_dir)
+    for layer in range(num_layers):
+        for d in range(2 if bidirectional else 1):
+            out[layer][d][2] = arr[p:p + m * h]
+            p += m * h
+            out[layer][d][3] = arr[p:p + m * h]
+            p += m * h
+    return out
+
+
+def _cell_step(mode, h):
+    if mode == "lstm":
+        def step(carry, gates):
+            hp, cp = carry
+            i, f, c, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            c = jnp.tanh(c)
+            o = jax.nn.sigmoid(o)
+            cn = f * cp + i * c
+            hn = o * jnp.tanh(cn)
+            return (hn, cn), hn
+    elif mode == "gru":
+        def step(carry, x):
+            raise NotImplementedError  # handled specially below
+    else:
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+        def step(carry, gates):
+            (hp,) = carry
+            hn = act(gates)
+            return (hn,), hn
+    return step
+
+
+def _run_layer(mode, x, wi, wh, bi, bh, h0, c0, reverse=False):
+    """x (T, N, li) → outputs (T, N, H). Sequential scan over time."""
+    m_h = wi.shape[0]
+    h = h0.shape[-1]
+    # precompute input projections for the whole sequence: one big matmul
+    # (T*N, li) @ (li, m*h) — MXU-friendly, the scan body only does h2h
+    xi = jnp.einsum("tnc,gc->tng", x, wi) + bi
+    if reverse:
+        xi = jnp.flip(xi, axis=0)
+
+    if mode == "lstm":
+        def body(carry, xg):
+            hp, cp = carry
+            gates = xg + hp @ wh.T + bh
+            i, f, c, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            c = jnp.tanh(c)
+            o = jax.nn.sigmoid(o)
+            cn = f * cp + i * c
+            hn = o * jnp.tanh(cn)
+            return (hn, cn), hn
+
+        (hT, cT), ys = jax.lax.scan(body, (h0, c0), xi)
+    elif mode == "gru":
+        def body(carry, xg):
+            hp = carry
+            hg = hp @ wh.T + bh
+            xr, xz, xo = jnp.split(xg, 3, axis=-1)
+            hr, hz, ho = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            o = jnp.tanh(xo + r * ho)
+            hn = o + z * (hp - o)
+            return hn, hn
+
+        hT, ys = jax.lax.scan(body, h0, xi)
+        cT = None
+    else:
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+        def body(carry, xg):
+            hp = carry
+            hn = act(xg + hp @ wh.T + bh)
+            return hn, hn
+
+        hT, ys = jax.lax.scan(body, h0, xi)
+        cT = None
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, hT, cT
+
+
+def _rnn(ins, params, mode_ctx):
+    mode = params["mode"]
+    num_layers = params["num_layers"]
+    h = params["state_size"]
+    bidir = params["bidirectional"]
+    is_lstm = mode == "lstm"
+    if is_lstm:
+        data, parameters, state, state_cell = ins
+    else:
+        data, parameters, state = ins
+        state_cell = None
+    T, N, C = data.shape
+    dirs = 2 if bidir else 1
+    layers = _slice_rnn_params(parameters, mode, num_layers, bidir, C, h)
+
+    p_drop = params["p"]
+    x = data
+    hTs, cTs = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            wi, wh, bi, bh = layers[layer][d]
+            sidx = layer * dirs + d
+            h0 = state[sidx]
+            c0 = state_cell[sidx] if is_lstm else None
+            ys, hT, cT = _run_layer(
+                mode, x, wi, wh, bi, bh, h0, c0, reverse=(d == 1)
+            )
+            outs.append(ys)
+            hTs.append(hT)
+            if is_lstm:
+                cTs.append(cT)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p_drop > 0 and mode_ctx.is_train and layer < num_layers - 1:
+            key = jax.random.fold_in(mode_ctx.rng, layer)
+            keep = 1.0 - p_drop
+            x = x * jax.random.bernoulli(key, keep, x.shape) / keep
+
+    outputs = [x]
+    outputs.append(jnp.stack(hTs))
+    if is_lstm:
+        outputs.append(jnp.stack(cTs))
+    return outputs
+
+
+def _rnn_args(p):
+    args = ["data", "parameters", "state"]
+    if p["mode"] == "lstm":
+        args.append("state_cell")
+    return args
+
+
+def _rnn_fill(shapes, params):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    T, N, C = data
+    h = params["state_size"]
+    L = params["num_layers"]
+    dirs = 2 if params["bidirectional"] else 1
+    if shapes[1] is None:
+        shapes[1] = (
+            _rnn_param_size(params["mode"], L, params["bidirectional"], C, h),
+        )
+    if shapes[2] is None:
+        shapes[2] = (L * dirs, N, h)
+    if params["mode"] == "lstm" and shapes[3] is None:
+        shapes[3] = (L * dirs, N, h)
+    return shapes
+
+
+register(
+    "RNN",
+    _rnn,
+    arg_names=_rnn_args,
+    param_schema={
+        "state_size": Param(parse_int),
+        "num_layers": Param(parse_int),
+        "mode": Param(parse_str),
+        "bidirectional": Param(parse_bool, False),
+        "p": Param(parse_float, 0.0),
+        "state_outputs": Param(parse_bool, False),
+        "pkeep_": Param(parse_float, None),
+        "lstm_q_": Param(parse_bool, None),
+    },
+    fill_in_shapes=_rnn_fill,
+    need_rng=True,
+    num_outputs=lambda p: 3 if p["mode"] == "lstm" else 2,
+    num_visible_outputs=lambda p: (
+        (3 if p["mode"] == "lstm" else 2) if p["state_outputs"] else 1
+    ),
+    aliases=("rnn",),
+)

@@ -1,0 +1,312 @@
+"""Spatial/vision operators.
+
+Reference: ``src/operator/`` — ``spatial_transformer``, ``grid_generator``,
+``bilinear_sampler`` (+cuDNN twins), ``correlation``, ``crop``,
+``softmax_cross_entropy``, CTC loss (``contrib/ctc_loss`` with vendored
+Baidu ctc_include). All expressed as composed-jax: bilinear sampling is a
+gather+lerp (vectorised, MXU-free but VPU-friendly), CTC is the standard
+log-space forward recursion under ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, parse_bool, parse_float, parse_int, parse_shape, parse_str
+from .registry import Param, register
+
+
+# --- bilinear sampling core ------------------------------------------------
+def _bilinear_sample(data, gx, gy):
+    """data (C, H, W); gx, gy (Ho, Wo) in pixel coords → (C, Ho, Wo).
+    Out-of-bounds samples are 0 (reference BilinearSampler padding)."""
+    C, H, W = data.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1 = x0 + 1
+    y1 = y0 + 1
+    wx1 = gx - x0
+    wy1 = gy - y0
+    wx0 = 1.0 - wx1
+    wy0 = 1.0 - wy1
+
+    def gather(xi, yi):
+        inb = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        vals = data[:, yc, xc]  # (C, Ho, Wo)
+        return jnp.where(inb[None], vals, 0.0)
+
+    return (
+        gather(x0, y0) * (wx0 * wy0)[None]
+        + gather(x1, y0) * (wx1 * wy0)[None]
+        + gather(x0, y1) * (wx0 * wy1)[None]
+        + gather(x1, y1) * (wx1 * wy1)[None]
+    )
+
+
+def _bilinear_sampler(ins, params, mode):
+    data, grid = ins
+    # grid (N, 2, Ho, Wo) in [-1, 1] (x, y); reference BilinearSampler
+    N, C, H, W = data.shape
+
+    def one(d, g):
+        gx = (g[0] + 1.0) * (W - 1) / 2.0
+        gy = (g[1] + 1.0) * (H - 1) / 2.0
+        return _bilinear_sample(d, gx, gy)
+
+    return jax.vmap(one)(data, grid)
+
+
+register(
+    "BilinearSampler",
+    _bilinear_sampler,
+    arg_names=["data", "grid"],
+)
+
+
+def _grid_generator(ins, params, mode):
+    (x,) = ins
+    th, tw = params["target_shape"]
+    if params["transform_type"] == "affine":
+        # x (N, 6) affine params; output grid (N, 2, th, tw) in [-1,1]
+        ys = jnp.linspace(-1.0, 1.0, th)
+        xs = jnp.linspace(-1.0, 1.0, tw)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, th*tw)
+
+        def one(theta):
+            A = theta.reshape(2, 3)
+            out = A @ base  # (2, th*tw)
+            return out.reshape(2, th, tw)
+
+        return jax.vmap(one)(x)
+    elif params["transform_type"] == "warp":
+        # x (N, 2, H, W) flow field in pixels; output normalized grid
+        N, _two, H, W = x.shape
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        px = gx[None] + x[:, 0]
+        py = gy[None] + x[:, 1]
+        nx = px * 2.0 / (W - 1) - 1.0
+        ny = py * 2.0 / (H - 1) - 1.0
+        return jnp.stack([nx, ny], axis=1)
+    raise MXNetError(f"GridGenerator: unknown transform_type")
+
+
+register(
+    "GridGenerator",
+    _grid_generator,
+    arg_names=["data"],
+    param_schema={
+        "transform_type": Param(parse_str, "affine"),
+        "target_shape": Param(parse_shape, (0, 0)),
+    },
+)
+
+
+def _spatial_transformer(ins, params, mode):
+    data, loc = ins
+    th, tw = params["target_shape"]
+    grid = _grid_generator(
+        [loc], {"transform_type": "affine", "target_shape": (th, tw)}, mode
+    )
+    return _bilinear_sampler([data, grid], {}, mode)
+
+
+def _st_fill(shapes, params):
+    # loc comes from a localisation net; nothing to fill beyond data
+    return shapes
+
+
+register(
+    "SpatialTransformer",
+    _spatial_transformer,
+    arg_names=["data", "loc"],
+    param_schema={
+        "target_shape": Param(parse_shape),
+        "transform_type": Param(parse_str, "affine"),
+        "sampler_type": Param(parse_str, "bilinear"),
+        "cudnn_off": Param(parse_bool, False),
+    },
+)
+
+
+# --- Correlation -----------------------------------------------------------
+def _correlation(ins, params, mode):
+    a, b = ins
+    # FlowNet-style correlation (reference correlation-inl.h), stride1/2=1
+    md = params["max_displacement"]
+    k = params["kernel_size"]
+    pad = params["pad_size"]
+    N, C, H, W = a.shape
+    ap = jnp.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    bp = jnp.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    D = 2 * md + 1
+    outs = []
+    for dy in range(-md, md + 1):
+        for dx in range(-md, md + 1):
+            shifted = jnp.roll(bp, shift=(-dy, -dx), axis=(2, 3))
+            prod = (ap * shifted).mean(axis=1)  # (N, H+2p, W+2p)
+            outs.append(prod[:, pad:pad + H, pad:pad + W])
+    return jnp.stack(outs, axis=1)  # (N, D*D, H, W)
+
+
+register(
+    "Correlation",
+    _correlation,
+    arg_names=["data1", "data2"],
+    param_schema={
+        "kernel_size": Param(parse_int, 1),
+        "max_displacement": Param(parse_int, 1),
+        "stride1": Param(parse_int, 1),
+        "stride2": Param(parse_int, 1),
+        "pad_size": Param(parse_int, 0),
+        "is_multiply": Param(parse_bool, True),
+    },
+)
+
+
+# --- Crop ------------------------------------------------------------------
+def _crop_op(ins, params, mode):
+    data = ins[0]
+    h_w = params["h_w"]
+    offset = params["offset"]
+    if params["num_args"] == 2:
+        like = ins[1]
+        th, tw = like.shape[2], like.shape[3]
+    else:
+        th, tw = h_w
+    if params["center_crop"]:
+        oy = (data.shape[2] - th) // 2
+        ox = (data.shape[3] - tw) // 2
+    else:
+        oy, ox = offset
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+register(
+    "Crop",
+    _crop_op,
+    arg_names=lambda p: ["data"] + (["crop_like"] if p["num_args"] == 2 else []),
+    param_schema={
+        "num_args": Param(parse_int, 1),
+        "offset": Param(parse_shape, (0, 0)),
+        "h_w": Param(parse_shape, (0, 0)),
+        "center_crop": Param(parse_bool, False),
+    },
+)
+
+
+# --- softmax_cross_entropy -------------------------------------------------
+def _softmax_cross_entropy(ins, params, mode):
+    data, label = ins
+    logp = jax.nn.log_softmax(data, axis=-1)
+    li = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, li[:, None], axis=1)[:, 0]
+    return -jnp.sum(picked).reshape(1)
+
+
+register(
+    "softmax_cross_entropy",
+    _softmax_cross_entropy,
+    arg_names=["data", "label"],
+)
+
+
+# --- CTC loss --------------------------------------------------------------
+def _ctc_loss(ins, params, mode):
+    """CTC negative log-likelihood (reference contrib/ctc_loss with Baidu
+    warp-ctc). Blank label = 0, labels are 1-based like the reference.
+
+    data (T, N, V) unnormalised activations, label (N, L) padded with 0.
+    Output: loss (N,). Standard log-space alpha recursion via lax.scan.
+    """
+    data, label = ins
+    T, N, V = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data, axis=-1)  # (T, N, V)
+    neg_inf = -1e30
+
+    def one(logp_n, lbl):
+        lbl = lbl.astype(jnp.int32)
+        lab_len = jnp.sum(lbl > 0)
+        S = 2 * L + 1
+        # extended label sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.zeros((S,), jnp.int32)
+        ext = ext.at[1::2].set(lbl)
+        # alpha init
+        alpha0 = jnp.full((S,), neg_inf)
+        alpha0 = alpha0.at[0].set(logp_n[0, 0])
+        alpha0 = alpha0.at[1].set(
+            jnp.where(lab_len > 0, logp_n[0, ext[1]], neg_inf)
+        )
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.array([True, True]), ext[2:] == ext[:-2]]
+        )
+
+        def step(alpha, logp_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate([jnp.array([neg_inf]), alpha[:-1]])
+            a_shift2 = jnp.concatenate([jnp.full((2,), neg_inf), alpha[:-2]])
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+            new_alpha = merged + logp_t[ext]
+            return new_alpha, None
+
+        alphaT, _ = jax.lax.scan(step, alpha0, logp_n[1:])
+        end1 = alphaT[2 * lab_len]      # final blank
+        end2 = jnp.where(
+            lab_len > 0, alphaT[2 * lab_len - 1], neg_inf
+        )
+        return -jnp.logaddexp(end1, end2)
+
+    return jax.vmap(one, in_axes=(1, 0))(logp, label)
+
+
+register(
+    "ctc_loss",
+    _ctc_loss,
+    arg_names=["data", "label"],
+    aliases=("_contrib_ctc_loss", "CTCLoss", "_contrib_CTCLoss"),
+)
+
+
+# --- quantization stubs (reference contrib/quantize.cc) --------------------
+def _quantize(ins, params, mode):
+    data, min_r, max_r = ins
+    qmin, qmax = -127.0, 127.0
+    scale = (qmax - qmin) / (max_r - min_r + 1e-12)
+    q = jnp.clip(jnp.round((data - min_r) * scale + qmin), qmin, qmax)
+    return [q.astype(jnp.int8), min_r, max_r]
+
+
+register(
+    "quantize",
+    _quantize,
+    arg_names=["data", "min_range", "max_range"],
+    param_schema={"out_type": Param(parse_str, "int8")},
+    num_outputs=3,
+    aliases=("_contrib_quantize",),
+)
+
+
+def _dequantize(ins, params, mode):
+    data, min_r, max_r = ins
+    qmin, qmax = -127.0, 127.0
+    scale = (max_r - min_r + 1e-12) / (qmax - qmin)
+    return (data.astype(jnp.float32) - qmin) * scale + min_r
+
+
+register(
+    "dequantize",
+    _dequantize,
+    arg_names=["data", "min_range", "max_range"],
+    param_schema={"out_type": Param(parse_str, "float32")},
+    aliases=("_contrib_dequantize",),
+)

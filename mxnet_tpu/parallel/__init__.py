@@ -16,3 +16,4 @@ from .mesh import (
     shard_batch,
     with_mesh,
 )
+from .ring_attention import ring_attention, sequence_parallel_sharding

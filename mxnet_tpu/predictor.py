@@ -1,0 +1,111 @@
+"""Standalone inference predictor.
+
+Reference: the C predict ABI (``include/mxnet/c_predict_api.h`` +
+``src/c_api/c_predict_api.cc``) used by amalgamation/mobile/JS deployments:
+create a predictor from symbol JSON + params blob, set input, forward, get
+output — no training machinery in the loop.
+
+TPU-native: a Predictor compiles one inference-only jitted program per input
+shape; ``mx.predictor.Predictor(json, params, shapes)`` mirrors
+``MXPredCreate``'s signature shape.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu
+from .executor import Executor
+from .ndarray import NDArray, array, load as nd_load, zeros
+from .symbol import fromjson, load as sym_load
+
+
+class Predictor:
+    """Inference-only predictor (reference ``MXPredCreate`` semantics)."""
+
+    def __init__(self, symbol_json_or_file, param_source, input_shapes,
+                 ctx=None, dev_type="cpu", dev_id=0, output_index=None):
+        if isinstance(symbol_json_or_file, str) and symbol_json_or_file.lstrip().startswith("{"):
+            symbol = fromjson(symbol_json_or_file)
+        else:
+            symbol = sym_load(symbol_json_or_file)
+        if output_index is not None:
+            symbol = symbol[output_index]
+        self.symbol = symbol
+        if ctx is None:
+            ctx = Context(dev_type, dev_id)
+        self.ctx = ctx
+
+        if isinstance(param_source, (str, bytes)):
+            params = nd_load(param_source)
+        else:
+            params = param_source
+        self.arg_params = {}
+        self.aux_params = {}
+        for k, v in params.items():
+            if k.startswith("arg:"):
+                self.arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                self.aux_params[k[4:]] = v
+            else:
+                self.arg_params[k] = v
+
+        self.input_shapes = dict(input_shapes)
+        self._bind()
+
+    def _bind(self):
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**self.input_shapes)
+        arg_names = self.symbol.list_arguments()
+        aux_names = self.symbol.list_auxiliary_states()
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in self.input_shapes:
+                args[name] = zeros(shape, ctx=self.ctx)
+            elif name in self.arg_params:
+                if tuple(self.arg_params[name].shape) != tuple(shape):
+                    raise MXNetError(
+                        f"param {name} shape mismatch: bound {shape}, "
+                        f"file {self.arg_params[name].shape}"
+                    )
+                args[name] = self.arg_params[name]
+            else:
+                raise MXNetError(f"missing parameter {name!r}")
+        auxs = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if name in self.aux_params:
+                auxs[name] = self.aux_params[name]
+            else:
+                auxs[name] = zeros(shape, ctx=self.ctx)
+        self._exec = Executor(
+            self.symbol, self.ctx, args=args, grad_req="null", aux_states=auxs
+        )
+
+    def reshape(self, input_shapes):
+        """Re-bind with new input shapes (reference MXPredReshape)."""
+        self.input_shapes = dict(input_shapes)
+        self._bind()
+
+    def set_input(self, name, data):
+        if name not in self.input_shapes:
+            raise MXNetError(f"{name!r} is not an input")
+        if not isinstance(data, NDArray):
+            data = array(np.asarray(data, np.float32))
+        data.copyto(self._exec.arg_dict[name])
+
+    def forward(self, **kwargs):
+        for k, v in kwargs.items():
+            self.set_input(k, v)
+        self._exec.forward(is_train=False)
+
+    def get_output(self, index):
+        return self._exec.outputs[index].asnumpy()
+
+    @property
+    def num_outputs(self):
+        return len(self._exec.outputs)
+
+
+def load_ndarray_file(nd_bytes_or_file):
+    """Reference MXNDListCreate: load a params blob to a dict."""
+    return nd_load(nd_bytes_or_file)

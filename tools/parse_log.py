@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (reference tools/parse_log.py)."""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Parse mxnet_tpu training logs")
+    parser.add_argument("logfile", help="log file path")
+    parser.add_argument("--format", type=str, default="markdown",
+                        choices=["markdown", "none"])
+    args = parser.parse_args()
+
+    with open(args.logfile) as f:
+        lines = f.readlines()
+
+    res = [
+        re.compile(r"Epoch\[(\d+)\] Train-([^=]+)=([.\d]+)"),
+        re.compile(r"Epoch\[(\d+)\] Validation-([^=]+)=([.\d]+)"),
+        re.compile(r"Epoch\[(\d+)\] Time cost=([.\d]+)"),
+    ]
+    data = {}
+    for l in lines:
+        m = res[0].search(l)
+        if m:
+            data.setdefault(int(m.group(1)), {})[f"train-{m.group(2)}"] = float(m.group(3))
+        m = res[1].search(l)
+        if m:
+            data.setdefault(int(m.group(1)), {})[f"val-{m.group(2)}"] = float(m.group(3))
+        m = res[2].search(l)
+        if m:
+            data.setdefault(int(m.group(1)), {})["time"] = float(m.group(2))
+
+    if not data:
+        print("no epoch records found", file=sys.stderr)
+        return
+    cols = sorted({k for v in data.values() for k in v})
+    if args.format == "markdown":
+        print("| epoch | " + " | ".join(cols) + " |")
+        print("| --- " * (len(cols) + 1) + "|")
+        for epoch in sorted(data):
+            row = [f"{data[epoch].get(c, float('nan')):.6g}" for c in cols]
+            print(f"| {epoch} | " + " | ".join(row) + " |")
+    else:
+        for epoch in sorted(data):
+            print(epoch, data[epoch])
+
+
+if __name__ == "__main__":
+    main()
