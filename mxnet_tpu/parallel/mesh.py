@@ -18,11 +18,13 @@ from ..base import MXNetError
 _state = threading.local()
 
 
-def make_mesh(axis_sizes, devices=None):
+def make_mesh(axis_sizes, devices=None, backend=None):
     """Create a Mesh with named axes, e.g. make_mesh({'dp': 4, 'tp': 2}).
 
-    Uses all visible devices by default; total size must divide/match the
-    device count. Multi-host: devices spans all processes (jax global view).
+    Uses all visible devices by default; ``backend="cpu"`` selects that
+    backend's devices (e.g. the virtual CPU mesh used to validate multi-chip
+    sharding on a single-chip host). Total size must divide/match the device
+    count. Multi-host: devices spans all processes (jax global view).
     """
     import jax
     from jax.sharding import Mesh
@@ -30,7 +32,7 @@ def make_mesh(axis_sizes, devices=None):
     names = tuple(axis_sizes.keys())
     sizes = tuple(int(v) for v in axis_sizes.values())
     if devices is None:
-        devices = jax.devices()
+        devices = jax.devices(backend)  # backend=None → default backend
     total = int(np.prod(sizes))
     if total > len(devices):
         raise MXNetError(
