@@ -22,7 +22,7 @@ def main():
     from mxnet_tpu import models
 
     on_tpu = jax.devices()[0].platform != "cpu"
-    batch_size = int(os.environ.get("BENCH_BATCH", 64 if on_tpu else 8))
+    batch_size = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16" if on_tpu else "float32")
     warmup = 3
     iters = int(os.environ.get("BENCH_ITERS", 20 if on_tpu else 3))
@@ -55,15 +55,21 @@ def main():
         mod.forward_backward(batch)
         mod.update()
 
+    def fence():
+        # a device->host fetch is the only true execution barrier on every
+        # backend (block_until_ready can ack before remote execution
+        # completes on tunneled runtimes); the last step's output depends
+        # on the whole step chain, so one scalar fetch fences everything
+        np.asarray(mod.get_outputs()[0]._data[0, :1])
+
     for _ in range(warmup):
         step()
-    mx.nd.waitall()
+    fence()
 
     tic = time.time()
     for _ in range(iters):
         step()
-    mod.get_outputs()[0].wait_to_read()
-    mx.nd.waitall()
+    fence()
     elapsed = time.time() - tic
 
     img_per_sec = batch_size * iters / elapsed

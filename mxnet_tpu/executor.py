@@ -39,6 +39,14 @@ from .ops.registry import OpMode
 _GRAD_REQ = ("write", "add", "null")
 
 
+def _fold_rng(rng):
+    """Fold a (base_key, step) pair into a per-step PRNG key, inside jit."""
+    import jax
+
+    base, step = rng
+    return jax.random.fold_in(base, step)
+
+
 class _CompiledGraph:
     """The symbol lowered to a pure function over ordered value lists."""
 
@@ -146,6 +154,7 @@ class Executor:
 
         self._base_key = jax.random.PRNGKey(0)
         self._jit_cache = {}
+        self._fused_plan = {}  # (names, token, hg, treedef) -> (fn, idxs)
         if shared_exec is not None:
             # bucketing: share compiled-function cache and memory with the
             # master executor (reference shared_exec data_pool_ reuse,
@@ -211,10 +220,15 @@ class Executor:
         return [self.aux_dict[n]._data for n in self.aux_names]
 
     def _rng_key(self):
-        import jax
+        """Per-step rng as a (base_key, step) pair.
 
-        key = jax.random.fold_in(self._base_key, self._step)
-        return key
+        The fold happens INSIDE the jitted program (``_fold_rng``): the base
+        key is a device-resident constant (transferred once) and the step a
+        tiny scalar marshalled with the call, so advancing the rng costs no
+        extra device dispatch — a host-side ``fold_in`` here was a full
+        round-trip per training step on tunneled runtimes.
+        """
+        return (self._base_key, np.uint32(self._step))
 
     def _get_jit(self, kind, is_train=False, with_head_grads=False):
         """Build (lazily) the jitted program for this graph shape-signature."""
@@ -237,49 +251,62 @@ class Executor:
         if kind == "forward":
 
             def _fwd(arg_vals, aux_vals, rng):
-                outs, aux_upd = graph.evaluate(arg_vals, aux_vals, rng, is_train)
+                outs, aux_upd = graph.evaluate(
+                    arg_vals, aux_vals, _fold_rng(rng), is_train
+                )
                 return outs, aux_upd
 
             fn = jax.jit(_fwd)
         elif kind == "train_step":
-            import jax.numpy as jnp
-
-            wrt_idx = [self.arg_names.index(n) for n in self._wrt_names]
-            add_names = [n for n in self._wrt_names if self.grad_req[n] == "add"]
-
-            def _train(arg_vals, aux_vals, rng, head_grads, prev_grads):
-                def loss_fn(wrt_vals):
-                    full = list(arg_vals)
-                    for i, v in zip(wrt_idx, wrt_vals):
-                        full[i] = v
-                    outs, aux_upd = graph.evaluate(full, aux_vals, rng, True)
-                    total = None
-                    for j, o in enumerate(outs):
-                        if not jnp.issubdtype(o.dtype, jnp.floating):
-                            continue
-                        hg = (
-                            head_grads[j]
-                            if head_grads is not None
-                            else jnp.ones_like(o)
-                        )
-                        t = jnp.sum(o.astype(jnp.float32) * hg.astype(jnp.float32))
-                        total = t if total is None else total + t
-                    if total is None:
-                        total = jnp.zeros((), jnp.float32)
-                    return total, (outs, aux_upd)
-
-                wrt_vals = [arg_vals[i] for i in wrt_idx]
-                grads, (outs, aux_upd) = jax.grad(loss_fn, has_aux=True)(wrt_vals)
-                grad_map = dict(zip(self._wrt_names, grads))
-                for n in add_names:
-                    grad_map[n] = grad_map[n] + prev_grads[n]
-                return outs, aux_upd, grad_map
-
-            fn = jax.jit(_train)
+            fn = jax.jit(self._make_grad_core())
         else:
             raise MXNetError(f"unknown jit kind {kind}")
         self._jit_cache[cache_key] = fn
         return fn
+
+    def _make_grad_core(self):
+        """Shared fwd+bwd tracing core used by both the plain train_step
+        program and the fused train_update program, so loss construction /
+        head-grad conventions / add-req accumulation can never diverge."""
+        import jax
+        import jax.numpy as jnp
+
+        graph = self.graph
+        wrt_idx = [graph._arg_index[n] for n in self._wrt_names]
+        wrt_names = tuple(self._wrt_names)
+        add_names = [n for n in self._wrt_names if self.grad_req[n] == "add"]
+
+        def core(arg_vals, aux_vals, rng, head_grads, prev_grads):
+            key = _fold_rng(rng)
+
+            def loss_fn(wrt_vals):
+                full = list(arg_vals)
+                for i, v in zip(wrt_idx, wrt_vals):
+                    full[i] = v
+                outs, aux_upd = graph.evaluate(full, aux_vals, key, True)
+                total = None
+                for j, o in enumerate(outs):
+                    if not jnp.issubdtype(o.dtype, jnp.floating):
+                        continue
+                    hg = (
+                        head_grads[j]
+                        if head_grads is not None
+                        else jnp.ones_like(o)
+                    )
+                    t = jnp.sum(o.astype(jnp.float32) * hg.astype(jnp.float32))
+                    total = t if total is None else total + t
+                if total is None:
+                    total = jnp.zeros((), jnp.float32)
+                return total, (outs, aux_upd)
+
+            wrt_vals = [arg_vals[i] for i in wrt_idx]
+            grads, (outs, aux_upd) = jax.grad(loss_fn, has_aux=True)(wrt_vals)
+            grad_map = dict(zip(wrt_names, grads))
+            for n in add_names:
+                grad_map[n] = grad_map[n] + prev_grads[n]
+            return outs, aux_upd, grad_map
+
+        return core
 
     # ------------------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
@@ -300,6 +327,11 @@ class Executor:
             if name in self._in_shardings:
                 src = jax.device_put(src, self._in_shardings[name])
             tgt._data = src
+        # engine write-ordering: a still-scheduled backward must land its
+        # grad/aux/output writes before this newer forward supersedes them
+        # (in the steady train loop update() has already consumed it)
+        if getattr(self, "_bwd_scheduled", False):
+            self._materialize_backward()
         self._pending = "train" if is_train else "eval"
         self._fresh = False
         self._step += 1
@@ -310,6 +342,7 @@ class Executor:
         # and (b) BatchNorm moving stats update exactly once per forward().
         self._args_in = self._arg_vals()
         self._aux_in = self._aux_vals()
+        self._fwd_rng = self._rng_key()
         if self._monitor_callback is not None:
             self._materialize_forward()
         else:
@@ -323,17 +356,20 @@ class Executor:
         is_train = self._pending == "train"
         args_in = getattr(self, "_args_in", None) or self._arg_vals()
         aux_in = getattr(self, "_aux_in", None) or self._aux_vals()
+        rng = getattr(self, "_fwd_rng", None) or self._rng_key()
         if self._monitor_callback is not None:
+            import jax
+
             outs, aux_upd = self.graph.evaluate(
                 args_in,
                 aux_in,
-                self._rng_key(),
+                jax.random.fold_in(rng[0], int(rng[1])),
                 is_train,
                 monitor=self._monitor_callback,
             )
         else:
             fn = self._get_jit("forward", is_train=is_train)
-            outs, aux_upd = fn(args_in, aux_in, self._rng_key())
+            outs, aux_upd = fn(args_in, aux_in, rng)
         self._set_outputs(outs)
         self._set_aux(aux_upd)
         self._pending = None
@@ -343,8 +379,9 @@ class Executor:
         for h, o in zip(self._output_handles, outs):
             h._data = o
 
-    def _set_aux(self, aux_upd):
-        snap = getattr(self, "_aux_in", None)
+    def _set_aux(self, aux_upd, snap=None):
+        if snap is None:
+            snap = getattr(self, "_aux_in", None)
         for i, (n, v) in enumerate(zip(self.aux_names, aux_upd)):
             handle = self.aux_dict[n]
             # last-write-wins: if someone wrote to this aux between forward()
@@ -362,34 +399,177 @@ class Executor:
         return list(self._output_handles)
 
     def backward(self, out_grads=None, is_train=True):
-        """Fused forward+backward in one XLA program; fills grad arrays."""
+        """Schedule the fused forward+backward program (lazy).
+
+        The program runs when outputs or gradients are first read. If a
+        fused optimizer update (``fused_train_update``) consumes the
+        schedule first, forward+backward+update all execute as ONE donated
+        XLA program — the whole training iteration is a single dispatch.
+        """
         if self._pending is None and not self._fresh:
             raise MXNetError("backward called before forward")
         if out_grads is not None and not isinstance(out_grads, (list, tuple)):
             out_grads = [out_grads]
-        with_hg = out_grads is not None
-        fn = self._get_jit("train_step", with_head_grads=with_hg)
         head_grads = None
-        if with_hg:
+        if out_grads is not None:
             head_grads = [
                 g._data if isinstance(g, NDArray) else g for g in out_grads
             ]
-        prev = {
+        # capture add-req grad bases BEFORE the handles go lazy, and the
+        # input snapshot NOW — a later forward() overwrites _args_in, and
+        # this deferred program must compute from the batch it was
+        # scheduled against
+        self._bwd_prev = {
             n: self.grad_dict[n]._data
             for n in self._wrt_names
             if self.grad_req[n] == "add"
         }
-        args_in = getattr(self, "_args_in", None) or self._arg_vals()
-        aux_in = getattr(self, "_aux_in", None) or self._aux_vals()
+        self._bwd_args = getattr(self, "_args_in", None) or self._arg_vals()
+        self._bwd_aux = getattr(self, "_aux_in", None) or self._aux_vals()
+        self._bwd_heads = head_grads
+        self._bwd_scheduled = True
+        self._bwd_rng = self._rng_key()
+        for n in self._wrt_names:
+            self.grad_dict[n]._set_lazy(self._materialize_backward)
+        for h in self._output_handles:
+            h._set_lazy(self._materialize_backward)
+
+    def _materialize_backward(self):
+        """Run the scheduled fwd+bwd as one jitted program (no update)."""
+        if not getattr(self, "_bwd_scheduled", False):
+            return
+        head_grads = self._bwd_heads
+        with_hg = head_grads is not None
+        fn = self._get_jit("train_step", with_head_grads=with_hg)
         outs, aux_upd, grad_map = fn(
-            args_in, aux_in, self._rng_key(), head_grads, prev
+            self._bwd_args, self._bwd_aux, self._bwd_rng, head_grads,
+            self._bwd_prev,
         )
+        self._bwd_scheduled = False  # only consumed on success
         self._set_outputs(outs)
-        self._set_aux(aux_upd)
+        self._set_aux(aux_upd, snap=self._bwd_aux)
         for n, g in grad_map.items():
             self.grad_dict[n]._data = g
         self._pending = None
         self._fresh = True
+
+    def fused_train_update(self, update_names, apply_fn, states, lrs, wds, ts,
+                           cache_token):
+        """Forward + backward + optimizer update as ONE donated XLA program.
+
+        The TPU answer to the reference's fused update kernels
+        (``src/operator/optimizer_op.cc:18-167``) applied per-parameter by
+        ``Updater``: instead of ~#params separate dispatches per step after a
+        separate fwd/bwd launch, the whole training iteration is a single
+        jitted computation whose parameter / optimizer-state buffers are
+        donated, so XLA updates weights in place and fuses the optimizer
+        arithmetic into the backward pass.
+
+        Parameters
+        ----------
+        update_names : list of arg names to update (⊆ wrt names).
+        apply_fn : (i, weight, grad, state, lr, wd, t, rng) -> (w', state'),
+            traceable; ``i`` is the position in update_names (static).
+        states : list of state pytrees (jax-array leaves) aligned with
+            update_names; donated.
+        lrs, wds, ts : per-param host scalars, passed traced (no recompile
+            when an lr schedule changes them).
+        cache_token : hashable identity of the optimizer config; part of the
+            jit cache key.
+
+        Returns the list of new state pytrees. Outputs, aux states, gradient
+        arrays and parameter arrays are updated in place. Requires a
+        scheduled backward(); raises MXNetError otherwise.
+        """
+        import jax
+
+        if not getattr(self, "_bwd_scheduled", False):
+            raise MXNetError(
+                "fused_train_update requires a pending backward(); gradients "
+                "were already materialised — use the per-param update path"
+            )
+        head_grads = self._bwd_heads
+        with_hg = head_grads is not None
+
+        state_leaves, state_td = jax.tree_util.tree_flatten(list(states))
+        plan_key = (tuple(update_names), cache_token, with_hg, state_td)
+        plan = self._fused_plan.get(plan_key)
+        if plan is None:
+            arg_index = self.graph._arg_index
+            upd_idx = [arg_index[n] for n in update_names]
+            upd_set = set(upd_idx)
+            other_idx = [
+                i for i in range(len(self.arg_names)) if i not in upd_set
+            ]
+            core = self._make_grad_core()
+            n_args = len(self.arg_names)
+
+            def _step(upd_vals, other_vals, aux_vals, rng, heads, prev_grads,
+                      st_leaves, hyper):
+                full = [None] * n_args
+                for i, v in zip(upd_idx, upd_vals):
+                    full[i] = v
+                for i, v in zip(other_idx, other_vals):
+                    full[i] = v
+                outs, aux_upd, grad_map = core(
+                    full, aux_vals, rng, heads, prev_grads
+                )
+                key = _fold_rng(rng)
+                lr_v, wd_v, t_v = hyper[0], hyper[1], hyper[2]
+                sts = jax.tree_util.tree_unflatten(state_td, st_leaves)
+                new_params, new_states = [], []
+                for i, nm in enumerate(update_names):
+                    prng = jax.random.fold_in(key, 0x5EED + i)
+                    w, s = apply_fn(
+                        i, full[upd_idx[i]], grad_map[nm], sts[i],
+                        lr_v[i], wd_v[i], t_v[i], prng,
+                    )
+                    new_params.append(w)
+                    new_states.append(s)
+                new_leaves = jax.tree_util.tree_flatten(new_states)[0]
+                return outs, aux_upd, grad_map, new_params, new_leaves
+
+            plan = (
+                jax.jit(_step, donate_argnums=(0, 2, 6)), upd_idx, other_idx,
+            )
+            self._fused_plan[plan_key] = plan
+        fn, upd_idx, other_idx = plan
+
+        args_in = self._bwd_args
+        upd_vals = [args_in[i] for i in upd_idx]
+        other_vals = [args_in[i] for i in other_idx]
+        # one packed host->device transfer for all per-step hyperparams
+        hyper = np.stack([
+            np.asarray(lrs, np.float32),
+            np.asarray(wds, np.float32),
+            np.asarray(ts, np.float32),
+        ])
+
+        outs, aux_upd, grad_map, new_params, new_leaves = fn(
+            upd_vals, other_vals, self._bwd_aux, self._bwd_rng, head_grads,
+            self._bwd_prev, state_leaves, hyper,
+        )
+        self._bwd_scheduled = False  # only consumed on success
+        aux_snap = self._bwd_aux
+        # snapshots now reference donated buffers — drop them
+        self._args_in = None
+        self._aux_in = None
+        self._bwd_args = None
+        self._bwd_aux = None
+        self._set_outputs(outs)
+        self._set_aux(aux_upd, snap=aux_snap)
+        for nm, g in grad_map.items():
+            self.grad_dict[nm]._data = g
+        for nm, w, old in zip(update_names, new_params, upd_vals):
+            handle = self.arg_dict[nm]
+            # last-write-wins: a user write between forward() and update()
+            # (set_params / copy_params_from) keeps their value, matching
+            # the non-fused path's snapshot guard
+            if handle._d is old:
+                handle._data = w
+        self._pending = None
+        self._fresh = True
+        return jax.tree_util.tree_unflatten(state_td, new_leaves)
 
     # ------------------------------------------------------------------
     def set_monitor_callback(self, callback, monitor_all=False):

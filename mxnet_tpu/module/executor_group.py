@@ -240,6 +240,105 @@ class DataParallelExecutorGroup:
     def install_monitor(self, mon):
         mon.install(self._exec)
 
+    # ------------------------------------------------------------------
+    def has_pending_backward(self):
+        return getattr(self._exec, "_bwd_scheduled", False)
+
+    def update_fused(self, optimizer, updater):
+        """Apply the optimizer inside the executor's jitted train step.
+
+        TPU replacement for the reference's per-parameter ``Updater`` loop
+        over fused update kernels (``src/operator/optimizer_op.cc:18-167``):
+        forward, backward and every parameter/optimizer-state update execute
+        as one donated XLA program (see ``Executor.fused_train_update``).
+        Optimizer state stays in ``updater.states`` as the same NDArray
+        pytrees the imperative path uses, so state save/load and fallback to
+        that path remain coherent.
+        """
+        import jax
+
+        exe = self._exec
+        keys, names, lrs, wds, ts = [], [], [], [], []
+        nd_states, jax_states = [], []
+        for i, n in enumerate(self.param_names):
+            if n not in exe.arg_dict or exe.grad_req.get(n, "null") == "null":
+                continue
+            w = exe.arg_dict[n]
+            if i not in updater.states:
+                st = optimizer.create_state(i, w)
+                # co-locate state with the weight (sharding-aware) so the
+                # donated jit inputs alias without per-step resharding
+                st = _map_state(
+                    st,
+                    lambda nd: NDArray(
+                        jax.device_put(nd._data, w._data.sharding)
+                    ),
+                )
+                updater.states[i] = st
+            optimizer._update_count(i)
+            keys.append(i)
+            lrs.append(optimizer._get_lr(i))
+            wds.append(optimizer._get_wd(i))
+            ts.append(optimizer._index_update_count[i])
+            names.append(n)
+            nd_states.append(updater.states[i])
+            jax_states.append(_map_state(updater.states[i], lambda nd: nd._data))
+
+        def apply_fn(i, wv, gv, sv, lr, wd, t, rng):
+            return optimizer.jax_apply(wv, gv, sv, lr, wd, t, rng)
+
+        try:
+            new_states = exe.fused_train_update(
+                names, apply_fn, jax_states, lrs, wds, ts,
+                cache_token=_optimizer_token(optimizer),
+            )
+        except Exception:
+            # the step didn't happen — roll back the update counts so a
+            # retried/fallback update sees the right t and lr schedule
+            for i in keys:
+                optimizer._index_update_count[i] -= 1
+            optimizer.num_update = max(
+                [optimizer.begin_num_update]
+                + list(optimizer._index_update_count.values())
+            )
+            raise
+        for nd_st, new_st in zip(nd_states, new_states):
+            _write_state(nd_st, new_st)
+
+
+def _optimizer_token(optimizer):
+    """Hashable identity of everything an optimizer's jax_apply bakes into
+    the trace (hyperparams are trace constants except lr/wd/t); value-based
+    so a new or mutated optimizer never reuses a stale compiled program."""
+    # lr/wd/t are traced inputs; the count/schedule bookkeeping mutates
+    # every step and must not key the cache
+    mutable = {"lr", "wd", "num_update", "begin_num_update"}
+    static = {
+        k: v for k, v in sorted(vars(optimizer).items())
+        if k not in mutable and isinstance(v, (int, float, bool, str, type(None)))
+    }
+    return (type(optimizer).__name__,) + tuple(static.items())
+
+
+def _map_state(st, f):
+    """Map a leaf function over an optimizer-state pytree (None/tuple/NDArray)."""
+    if st is None:
+        return None
+    if isinstance(st, (list, tuple)):
+        return tuple(_map_state(x, f) for x in st)
+    return f(st)
+
+
+def _write_state(nd_st, new_st):
+    """Write new jax leaves back into the NDArray state pytree in place."""
+    if nd_st is None:
+        return
+    if isinstance(nd_st, (list, tuple)):
+        for a, b in zip(nd_st, new_st):
+            _write_state(a, b)
+        return
+    nd_st._data = new_st
+
 
 def _even_slices(batch_size, num):
     step = batch_size // num

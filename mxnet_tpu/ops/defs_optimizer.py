@@ -8,10 +8,10 @@ function that XLA fuses the same way. ``mx.optimizer`` calls them with
 ``out=weight`` for in-place semantics (handle rebinding at the NDArray layer,
 buffer donation under jit).
 
-All follow the reference's gradient preprocessing: ``grad = rescale_grad *
-grad [+ wd * weight]``, clipped to ``[-clip_gradient, clip_gradient]`` when
-``clip_gradient >= 0`` (clipping applies before wd for sgd/adam, matching
-optimizer_op-inl.h).
+All follow the reference's gradient preprocessing (optimizer_op-inl.h):
+sgd/rmsprop clip ``rescale_grad * grad`` and add ``wd`` terms outside the
+clip; adam folds ``wd * weight`` into the gradient *before* clipping
+(``AdamUpdate``: ``grad = rescale_grad*grad + wd*weight`` then ``clip``).
 """
 
 from __future__ import annotations
@@ -31,12 +31,14 @@ def _common_schema():
     }
 
 
-def _prep_grad(grad, weight, params, include_wd=True):
+def _prep_grad(grad, weight, params, include_wd=True, wd_before_clip=False):
     g = grad * params["rescale_grad"]
+    if include_wd and wd_before_clip:
+        g = g + params["wd"] * weight
     clip = params["clip_gradient"]
     if clip >= 0:
         g = jnp.clip(g, -clip, clip)
-    if include_wd:
+    if include_wd and not wd_before_clip:
         g = g + params["wd"] * weight
     return g
 
@@ -76,7 +78,7 @@ register(
 def _adam_update(ins, params, mode):
     weight, grad, mean, var = ins
     b1, b2, eps = params["beta1"], params["beta2"], params["epsilon"]
-    g = _prep_grad(grad, weight, params)
+    g = _prep_grad(grad, weight, params, wd_before_clip=True)
     new_mean = b1 * mean + (1.0 - b1) * g
     new_var = b2 * var + (1.0 - b2) * jnp.square(g)
     new_weight = weight - params["lr"] * new_mean / (jnp.sqrt(new_var) + eps)

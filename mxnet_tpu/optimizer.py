@@ -75,6 +75,38 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
 
+    # Traceable single-param update for the fused jitted train step
+    # (executor "train_update" program). Subclasses override with pure
+    # jax.numpy math mirroring their ``update``; ``state`` is the same
+    # pytree shape as ``create_state`` but with jax arrays as leaves,
+    # and ``lr``/``wd``/``t`` arrive as traced scalars so lr schedules
+    # never trigger recompilation. Returns (new_weight, new_state).
+    # None ⇒ this optimizer only supports the imperative per-param path.
+    jax_apply = None
+
+    def _fused_grad(self, grad, weight, wd=None):
+        """rescale → [wd] → clip preprocessing shared by jax_apply impls."""
+        import jax.numpy as jnp
+
+        g = grad * self.rescale_grad
+        if wd is not None:
+            g = g + wd * weight
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def _fused_params(self, lr, wd):
+        """Param dict for calling a registered update-op body from
+        jax_apply: lr/wd traced, clip/rescale static trace constants."""
+        return {
+            "lr": lr,
+            "wd": wd,
+            "rescale_grad": self.rescale_grad,
+            "clip_gradient": (
+                self.clip_gradient if self.clip_gradient is not None else -1.0
+            ),
+        }
+
     def set_lr_scale(self, args_lrscale):
         raise DeprecationWarning("Use set_lr_mult instead.")
 
@@ -155,6 +187,18 @@ class SGD(Optimizer):
         else:
             sgd_update(weight, grad, out=weight, **kwargs)
 
+    def jax_apply(self, weight, grad, state, lr, wd, t, rng):
+        # reuse the registered op bodies so fused and imperative paths share
+        # one copy of the update math (lr/wd arrive traced; clip is static)
+        from .ops.defs_optimizer import _sgd_mom_update, _sgd_update
+
+        params = self._fused_params(lr, wd)
+        if state is None:
+            return _sgd_update([weight, grad], params, None), None
+        params["momentum"] = self.momentum
+        new_w, new_mom = _sgd_mom_update([weight, grad, state], params, None)
+        return new_w, new_mom
+
 
 @register
 class DCASGD(Optimizer):
@@ -189,6 +233,16 @@ class DCASGD(Optimizer):
         previous_weight[:] = weight
         weight += update
 
+    def jax_apply(self, weight, grad, state, lr, wd, t, rng):
+        g = self._fused_grad(grad, weight)
+        mom, prev = state
+        delay = g * (weight - prev)
+        step = -lr * (g + wd * weight + self.lamda * g * delay)
+        if mom is None:
+            return weight + step, (None, weight)
+        new_mom = self.momentum * mom + step
+        return weight + new_mom, (new_mom, weight)
+
 
 @register
 class NAG(SGD):
@@ -211,6 +265,14 @@ class NAG(SGD):
         else:
             weight += -lr * (grad + wd * weight)
 
+    def jax_apply(self, weight, grad, state, lr, wd, t, rng):
+        g = self._fused_grad(grad, weight)
+        if state is None:
+            return weight - lr * (g + wd * weight), None
+        g = g + wd * weight
+        mom = self.momentum * state + g
+        return weight - lr * (g + self.momentum * mom), mom
+
 
 @register
 class SGLD(Optimizer):
@@ -228,6 +290,16 @@ class SGLD(Optimizer):
         weight += -lr / 2 * (grad + wd * weight) + normal(
             loc=0.0, scale=math.sqrt(lr), shape=weight.shape, dtype=weight.dtype
         )
+
+    def jax_apply(self, weight, grad, state, lr, wd, t, rng):
+        import jax
+        import jax.numpy as jnp
+
+        g = self._fused_grad(grad, weight)
+        noise = jnp.sqrt(lr) * jax.random.normal(
+            rng, weight.shape, weight.dtype
+        )
+        return weight - lr / 2 * (g + wd * weight) + noise, None
 
 
 @register
@@ -273,6 +345,21 @@ class Adam(Optimizer):
             clip_gradient=self.clip_gradient if self.clip_gradient is not None else -1.0,
         )
 
+    def jax_apply(self, weight, grad, state, lr, wd, t, rng):
+        import jax.numpy as jnp
+
+        from .ops.defs_optimizer import _adam_update
+
+        tf = t.astype(jnp.float32)
+        lr_t = lr * jnp.sqrt(1.0 - self.beta2 ** tf) / (1.0 - self.beta1 ** tf)
+        params = self._fused_params(lr_t, wd)
+        params.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+        mean, var = state
+        new_w, new_mean, new_var = _adam_update(
+            [weight, grad, mean, var], params, None
+        )
+        return new_w, (new_mean, new_var)
+
 
 @register
 class AdaGrad(Optimizer):
@@ -294,6 +381,16 @@ class AdaGrad(Optimizer):
         history += nd_square(grad)
         weight += (-lr * (grad / nd_sqrt(history + self.float_stable_eps)
                           + wd * weight))
+
+    def jax_apply(self, weight, grad, state, lr, wd, t, rng):
+        import jax.numpy as jnp
+
+        g = self._fused_grad(grad, weight)
+        hist = state + jnp.square(g)
+        new_w = weight - lr * (
+            g / jnp.sqrt(hist + self.float_stable_eps) + wd * weight
+        )
+        return new_w, hist
 
 
 @register
@@ -337,6 +434,27 @@ class RMSProp(Optimizer):
             rmspropalex_update(weight, grad, n, g, delta, out=weight,
                                gamma2=self.gamma2, **kwargs)
 
+    def jax_apply(self, weight, grad, state, lr, wd, t, rng):
+        from .ops.defs_optimizer import _rmsprop_update, _rmspropalex_update
+
+        params = self._fused_params(lr, wd)
+        params.update(
+            gamma1=self.gamma1, epsilon=self.epsilon,
+            clip_weights=(
+                self.clip_weights if self.clip_weights is not None else -1.0
+            ),
+        )
+        if not self.centered:
+            (n,) = state
+            new_w, new_n = _rmsprop_update([weight, grad, n], params, None)
+            return new_w, (new_n,)
+        n, mg, delta = state
+        params["gamma2"] = self.gamma2
+        new_w, new_n, new_mg, new_delta = _rmspropalex_update(
+            [weight, grad, n, mg, delta], params, None
+        )
+        return new_w, (new_n, new_mg, new_delta)
+
 
 @register
 class AdaDelta(Optimizer):
@@ -367,6 +485,21 @@ class AdaDelta(Optimizer):
             self.rho * acc_delta + (1.0 - self.rho) * nd_square(current_delta)
         )
         weight[:] = weight - current_delta - wd * weight
+
+    def jax_apply(self, weight, grad, state, lr, wd, t, rng):
+        import jax.numpy as jnp
+
+        g = self._fused_grad(grad, weight)
+        acc_g, acc_delta = state
+        new_acc_g = self.rho * acc_g + (1.0 - self.rho) * jnp.square(g)
+        delta = (
+            jnp.sqrt(acc_delta + self.epsilon)
+            / jnp.sqrt(new_acc_g + self.epsilon) * g
+        )
+        new_acc_delta = (
+            self.rho * acc_delta + (1.0 - self.rho) * jnp.square(delta)
+        )
+        return weight - delta - wd * weight, (new_acc_g, new_acc_delta)
 
 
 @register
@@ -404,6 +537,21 @@ class Ftrl(Optimizer):
         ) * (nd_abs(z) > self.lamda1)
         weight[:] = new_w
 
+    def jax_apply(self, weight, grad, state, lr, wd, t, rng):
+        import jax.numpy as jnp
+
+        g = self._fused_grad(grad, weight)
+        z, n = state
+        new_n = n + jnp.square(g)
+        sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+        new_z = z + g - sigma * weight
+        new_w = (
+            (jnp.sign(new_z) * self.lamda1 - new_z)
+            / ((self.beta + jnp.sqrt(new_n)) / lr + wd)
+            * (jnp.abs(new_z) > self.lamda1)
+        )
+        return new_w, (new_z, new_n)
+
 
 @register
 class Test(Optimizer):
@@ -416,6 +564,10 @@ class Test(Optimizer):
     def update(self, index, weight, grad, state):
         weight[:] = weight + grad * self.rescale_grad
         state[:] = weight
+
+    def jax_apply(self, weight, grad, state, lr, wd, t, rng):
+        new_w = weight + grad * self.rescale_grad
+        return new_w, new_w
 
 
 create = Optimizer.create_optimizer
