@@ -11,6 +11,9 @@ from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gp
 
 from . import ndarray
 from . import ndarray as nd
+from . import sparse_ndarray
+from . import sparse_ndarray as sparse_nd
+from .sparse_ndarray import RowSparseNDArray, CSRNDArray
 from . import random
 from . import random as rnd
 from . import autograd

@@ -457,3 +457,153 @@ def ImageRecordIter(**kwargs):
     from .recordio import ImageRecordIter as _Impl
 
     return _Impl(**kwargs)
+
+
+class LibSVMIter(DataIter):
+    """Sparse libsvm-format reader producing CSR data batches (reference
+    ``src/io/iter_libsvm.cc:170`` + sparse batch loader
+    ``iter_sparse_batchloader.h``).
+
+    Each line is ``label idx:val idx:val ...``; ``data_shape`` is the feature
+    dimension of one example. Labels come from ``label_libsvm`` if given
+    (also libsvm format) else from the leading value of each data line.
+    Batches carry a ``CSRNDArray`` — the TPU consumer densifies or feeds the
+    values/indices pair directly to sparse-aware kernels.
+    """
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=128, num_parts=1, part_index=0,
+                 **kwargs):
+        super().__init__(batch_size)
+        if isinstance(data_shape, int):
+            data_shape = (data_shape,)
+        assert len(data_shape) == 1, "data_shape must be 1-D (features,)"
+        self.data_shape = tuple(data_shape)
+        if isinstance(label_shape, int):
+            label_shape = (label_shape,)
+        self.label_shape = tuple(label_shape)
+        vals, cols, indptr, labels = self._parse(data_libsvm)
+        if label_libsvm is not None:
+            labels = self._dense_labels(label_libsvm)
+        elif self.label_shape != (1,):
+            raise MXNetError(
+                "LibSVMIter: label_shape != (1,) needs a label_libsvm file"
+            )
+        self.labels = np.asarray(labels, np.float32)
+        self.vals, self.cols, self.indptr = vals, cols, indptr
+        n = len(self.labels)
+        if num_parts > 1:
+            keep = np.arange(part_index, n, num_parts)
+            self._select_rows(keep)
+            n = len(self.labels)
+        self.num_data = n
+        self.cursor = -batch_size
+
+    def _parse(self, path):
+        vals, cols, labels = [], [], []
+        indptr = [0]
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    cols.append(int(i))
+                    vals.append(float(v))
+                indptr.append(len(vals))
+        return (
+            np.asarray(vals, np.float32),
+            np.asarray(cols, np.int64),
+            np.asarray(indptr, np.int64),
+            np.asarray(labels, np.float32),
+        )
+
+    def _dense_labels(self, path):
+        """Label file, libsvm format: scalar labels from the leading value
+        (label_shape=(1,)), vector labels densified from the idx:val pairs."""
+        if self.label_shape == (1,):
+            out = []
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if parts:
+                        out.append(float(parts[0]))
+            return np.asarray(out, np.float32)
+        rows = []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                row = np.zeros(self.label_shape, np.float32)
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    row[int(i)] = float(v)
+                rows.append(row)
+        return np.stack(rows) if rows else np.zeros((0,) + self.label_shape, np.float32)
+
+    def _select_rows(self, keep):
+        new_vals, new_cols = [], []
+        new_ptr = [0]
+        for r in keep:
+            lo, hi = self.indptr[r], self.indptr[r + 1]
+            new_vals.append(self.vals[lo:hi])
+            new_cols.append(self.cols[lo:hi])
+            new_ptr.append(new_ptr[-1] + hi - lo)
+        self.vals = np.concatenate(new_vals) if new_vals else self.vals[:0]
+        self.cols = np.concatenate(new_cols) if new_cols else self.cols[:0]
+        self.indptr = np.asarray(new_ptr, np.int64)
+        self.labels = self.labels[keep]
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        if self.label_shape == (1,):
+            return [DataDesc("softmax_label", (self.batch_size,))]
+        return [DataDesc("softmax_label", (self.batch_size,) + self.label_shape)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        from . import sparse_ndarray as _sp
+
+        if not self.iter_next():
+            raise StopIteration
+        b = self.cursor
+        e = min(b + self.batch_size, self.num_data)
+        lo, hi = int(self.indptr[b]), int(self.indptr[e])
+        indptr = self.indptr[b : e + 1] - self.indptr[b]
+        pad = self.batch_size - (e - b)
+        if pad:
+            # zero-pad the final partial batch to full batch_size (reference
+            # sparse batch loader pads; pad count reported via DataBatch.pad)
+            indptr = np.concatenate(
+                [indptr, np.full(pad, indptr[-1], indptr.dtype)]
+            )
+        data = _sp.csr(
+            self.vals[lo:hi],
+            indptr,
+            self.cols[lo:hi],
+            (self.batch_size,) + self.data_shape,
+        )
+        labels = self.labels[b:e]
+        if pad:
+            labels = np.concatenate(
+                [labels, np.zeros((pad,) + labels.shape[1:], labels.dtype)]
+            )
+        self._pad = pad
+        label = array(labels)
+        return DataBatch(data=[data], label=[label], pad=pad, index=None)
+
+    def getpad(self):
+        return getattr(self, "_pad", 0)

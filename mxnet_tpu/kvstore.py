@@ -66,22 +66,32 @@ class KVStore:
             self._store[k] = vv.copy()
 
     def push(self, key, value, priority=0):
+        from .sparse_ndarray import BaseSparseNDArray, elemwise_add
+
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
             if isinstance(v, (list, tuple)):
                 # multi-device push: values from a replicated/sharded run are
                 # already identical post-psum; a genuine per-device list is
-                # tree-summed like CommDevice::Reduce.
-                merged = v[0].copy()
-                for x in v[1:]:
-                    merged += x
+                # tree-summed like CommDevice::Reduce (row_sparse lists merge
+                # by row union, reference CommCPU sparse reduce comm.h:183-362).
+                if any(isinstance(x, BaseSparseNDArray) for x in v):
+                    merged = v[0]
+                    for x in v[1:]:
+                        merged = elemwise_add(merged, x)
+                else:
+                    merged = v[0].copy()
+                    for x in v[1:]:
+                        merged += x
             else:
-                merged = v.copy()
+                merged = v.copy() if not isinstance(v, BaseSparseNDArray) else v
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
             if self._updater is not None:
                 self._updater(_updater_key(k), merged, self._store[k])
             else:
+                if isinstance(merged, BaseSparseNDArray):
+                    merged = merged.todense()
                 self._store[k] = merged
 
     def pull(self, key, out=None, priority=0):
@@ -94,6 +104,28 @@ class KVStore:
                     src.copyto(x)
             else:
                 src.copyto(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows of the stored value as row_sparse
+        (reference ``KVStoreDist::PullRowSparse``, kvstore_dist.h:274-350 —
+        workers ship row ids, servers respond with just those rows)."""
+        from .sparse_ndarray import RowSparseNDArray
+        import numpy as np
+
+        assert out is not None and row_ids is not None
+        keys, outs = _key_value(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids] * len(keys)
+        for k, o, rid in zip(keys, outs, rids):
+            src = self._store[k]
+            rows = np.unique(np.asarray(rid.asnumpy(), np.int32))
+            vals = src._data[rows]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                if not isinstance(t, RowSparseNDArray):
+                    raise MXNetError("row_sparse_pull needs row_sparse outs")
+                t._values = vals
+                t._aux = [_as_idx(rows)]
+                t._d = None
 
     # --- optimizer plane ----------------------------------------------
     def set_optimizer(self, optimizer):
@@ -212,3 +244,9 @@ def _updater_key(k):
         return int(k)
     except ValueError:
         return k
+
+
+def _as_idx(np_arr):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np_arr.astype("int32"))

@@ -292,15 +292,29 @@ class DataParallelExecutorGroup:
                 names, apply_fn, jax_states, lrs, wds, ts,
                 cache_token=_optimizer_token(optimizer),
             )
-        except Exception:
-            # the step didn't happen — roll back the update counts so a
-            # retried/fallback update sees the right t and lr schedule
+        except Exception as e:
+            # roll back the update counts so a retried/fallback update sees
+            # the right t and lr schedule (valid for trace/compile failures,
+            # where donation never happened)
             for i in keys:
                 optimizer._index_update_count[i] -= 1
             optimizer.num_update = max(
                 [optimizer.begin_num_update]
                 + list(optimizer._index_update_count.values())
             )
+            # a RUNTIME failure after dispatch has already consumed the
+            # donated weight/state buffers — no retry is possible then
+            dead = any(
+                getattr(exe.arg_dict[n]._d, "is_deleted", lambda: False)()
+                for n in names
+                if exe.arg_dict[n]._d is not None
+            )
+            if dead:
+                raise MXNetError(
+                    "fused train step failed after buffer donation; executor "
+                    "parameters were invalidated — re-initialize via "
+                    "set_params()/load before continuing"
+                ) from e
             raise
         for nd_st, new_st in zip(nd_states, new_states):
             _write_state(nd_st, new_st)

@@ -646,7 +646,10 @@ def save(fname, data):
             f.write(struct.pack("<q", len(nb)))
             f.write(nb)
             np_arr = arr.asnumpy()
-            header = f"{np_arr.dtype.name}|{','.join(map(str, np_arr.shape))}".encode()
+            header = (
+                f"{np_arr.dtype.name}|{','.join(map(str, np_arr.shape))}"
+                f"|{arr.stype}".encode()
+            )
             f.write(struct.pack("<q", len(header)))
             f.write(header)
             buf = np.ascontiguousarray(np_arr).tobytes()
@@ -666,7 +669,9 @@ def load(fname):
             (nlen,) = struct.unpack("<q", f.read(8))
             name = f.read(nlen).decode()
             (hlen,) = struct.unpack("<q", f.read(8))
-            dtype_s, shape_s = f.read(hlen).decode().split("|")
+            parts = f.read(hlen).decode().split("|")
+            dtype_s, shape_s = parts[0], parts[1]
+            stype = parts[2] if len(parts) > 2 else "default"
             shape = tuple(int(x) for x in shape_s.split(",")) if shape_s else ()
             (blen,) = struct.unpack("<q", f.read(8))
             buf = f.read(blen)
@@ -677,7 +682,12 @@ def load(fname):
             else:
                 arr = np.frombuffer(buf, dtype=dtype_s).reshape(shape)
             names.append(name)
-            arrays.append(array(arr, dtype=arr.dtype))
+            out_arr = array(arr, dtype=arr.dtype)
+            if stype != "default":
+                from .sparse_ndarray import cast_storage as _cast
+
+                out_arr = _cast(out_arr, stype)
+            arrays.append(out_arr)
     if any(names):
         return dict(zip(names, arrays))
     return arrays
@@ -765,3 +775,43 @@ def _init_ops():
 
 
 _init_ops()
+
+
+# --- sparse-aware dispatch over the generated dense ops ---------------------
+# (the reference dispatches on storage type to FComputeEx kernels,
+# c_api_ndarray.cc:436-458; here the handful of sparse kernels live in
+# sparse_ndarray and everything else dense-falls-back automatically)
+_dense_dot = dot  # noqa: F821  (generated above)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
+    from .sparse_ndarray import BaseSparseNDArray, dot as _sp_dot
+
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        return _sp_dot(lhs, rhs, transpose_a, transpose_b)
+    return _dense_dot(
+        lhs, rhs, transpose_a=transpose_a, transpose_b=transpose_b, **kwargs
+    )
+
+
+def cast_storage(arr, storage_type="default", stype=None):
+    from .sparse_ndarray import cast_storage as _cast
+
+    return _cast(arr, stype or storage_type)
+
+
+def sparse_retain(data, indices):
+    from .sparse_ndarray import sparse_retain as _retain
+
+    return _retain(data, indices)
+
+
+_dense_elemwise_add = elemwise_add  # noqa: F821
+
+
+def elemwise_add(lhs, rhs, **kwargs):
+    from .sparse_ndarray import BaseSparseNDArray, elemwise_add as _sp_add
+
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        return _sp_add(lhs, rhs)
+    return _dense_elemwise_add(lhs, rhs, **kwargs)

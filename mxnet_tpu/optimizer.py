@@ -181,6 +181,17 @@ class SGD(Optimizer):
             lr=lr, wd=wd, rescale_grad=self.rescale_grad,
             clip_gradient=self.clip_gradient if self.clip_gradient is not None else -1.0,
         )
+        from .sparse_ndarray import RowSparseNDArray, sgd_update as rsp_sgd, \
+            sgd_mom_update as rsp_sgd_mom
+
+        if isinstance(grad, RowSparseNDArray):
+            # row_sparse grad: touch only stored rows (reference
+            # SGDDnsRspImpl/SGDMomDnsRspImpl, optimizer_op-inl.h)
+            if state is not None:
+                rsp_sgd_mom(weight, grad, state, momentum=self.momentum, **kwargs)
+            else:
+                rsp_sgd(weight, grad, **kwargs)
+            return
         if state is not None:
             sgd_mom_update(weight, grad, state, out=weight,
                            momentum=self.momentum, **kwargs)
@@ -338,6 +349,16 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr *= math.sqrt(coef2) / coef1
         mean, var = state
+        from .sparse_ndarray import RowSparseNDArray, adam_update as rsp_adam
+
+        if isinstance(grad, RowSparseNDArray):
+            rsp_adam(
+                weight, grad, mean, var, lr=lr, wd=wd, beta1=self.beta1,
+                beta2=self.beta2, epsilon=self.epsilon,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient if self.clip_gradient is not None else -1.0,
+            )
+            return
         adam_update(
             weight, grad, mean, var, out=weight, lr=lr, wd=wd,
             beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,

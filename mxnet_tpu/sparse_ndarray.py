@@ -1,0 +1,560 @@
+"""Sparse NDArray — row_sparse and CSR storage over dense jax arrays.
+
+Reference: ``python/mxnet/sparse_ndarray.py`` (576 LoC), storage types
+``include/mxnet/ndarray.h:69-80`` (kDefaultStorage / kRowSparseStorage /
+kCSRStorage with int64 aux tensors), C++ ``cast_storage``
+(``src/operator/nn/cast_storage-inl.h``) and sparse kernels in
+``src/operator/tensor/matrix_op.cc`` (csr dot) /
+``src/operator/optimizer_op-inl.h`` (row_sparse optimizer updates).
+
+TPU-native design
+-----------------
+XLA has no native sparse tensors, so a sparse NDArray here is a *structured
+pair of dense jax arrays* (values + integer aux indices), which is exactly
+the layout the MXU/VPU can work with: csr·dense dot lowers to one gather plus
+one ``segment_sum`` (both XLA-friendly), and row_sparse optimizer updates
+lower to a gather/scatter over only the touched rows. Anything without a
+sparse-aware kernel transparently *falls back to dense* — mirroring the
+reference's storage-fallback (``src/common/utils.h`` ``GetDefaultBlobs`` /
+``CastNonDefaultStorage``): reading ``._data`` on a sparse handle
+materialises (and caches) the dense form, so the whole dense op library
+works on sparse inputs unchanged.
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+from .base import MXNetError, np_dtype
+from .context import Context
+from .ndarray import NDArray, array as _dense_array, zeros as _dense_zeros
+from . import ndarray as _nd
+
+# Aux index dtype: the reference uses int64 (CUDA era); on TPU int32 is the
+# hardware-native index type (XLA emulates int64), so aux tensors are int32.
+_STORAGE_AUX_TYPES = {
+    "row_sparse": [np.int32],
+    "csr": [np.int32, np.int32],
+}
+
+
+def _asjax(x, dtype=None):
+    import jax.numpy as jnp
+
+    if isinstance(x, NDArray):
+        x = x._data
+    out = jnp.asarray(x)
+    if dtype is not None:
+        out = out.astype(np_dtype(dtype))
+    return out
+
+
+class BaseSparseNDArray(NDArray):
+    """Shared machinery for RowSparse/CSR arrays.
+
+    ``_values``/``_aux`` hold the sparse representation; the inherited dense
+    buffer ``_d`` is a lazily-materialised cache used by dense-fallback ops.
+    """
+
+    __slots__ = ("_values", "_aux", "_shape")
+
+    def __init__(self, values, aux, shape, ctx=None):
+        super().__init__(None, ctx)
+        self._values = values
+        self._aux = list(aux)
+        self._shape = tuple(int(s) for s in shape)
+
+    # --- storage ----------------------------------------------------------
+    @property
+    def _data(self):
+        if self._d is None:
+            self._d = self._to_dense_jax()
+        return self._d
+
+    @_data.setter
+    def _data(self, value):
+        # Dense write-back into a sparse handle (e.g. ``out=`` of a dense
+        # fallback op): re-sparsify so the handle keeps its storage type.
+        self._lazy = None
+        self._d = None
+        self._set_from_dense(value)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return np_dtype(self._values.dtype)
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        try:
+            dev = list(self._values.devices())[0]
+        except Exception:
+            from .context import cpu
+
+            return cpu()
+        return Context(dev.platform if dev.platform != "cpu" else "cpu", dev.id)
+
+    ctx = context
+
+    # --- sparse views -----------------------------------------------------
+    @property
+    def values(self):
+        """Read-only view of the values array."""
+        return NDArray(self._values, self._ctx)
+
+    @property
+    def _num_aux(self):
+        return len(_STORAGE_AUX_TYPES[self.stype])
+
+    @property
+    def aux_types(self):
+        return list(_STORAGE_AUX_TYPES[self.stype])
+
+    def _aux_type(self, i):
+        return np_dtype(self._aux[i].dtype)
+
+    # --- conversion -------------------------------------------------------
+    def todense(self):
+        return NDArray(self._data, self._ctx)
+
+    to_dense = todense
+
+    def asnumpy(self):
+        return np.asarray(self._data)
+
+    def astype(self, dtype):
+        dt = np_dtype(dtype)
+        out = self.copy()
+        out._values = out._values.astype(dt)
+        out._d = None
+        return out
+
+    def copyto(self, other):
+        import jax
+
+        if isinstance(other, BaseSparseNDArray):
+            if other is self:
+                return other
+            src = self if self.stype == other.stype else cast_storage(self, other.stype)
+            other._values = src._values.astype(other.dtype)
+            other._aux = list(src._aux)
+            other._shape = src._shape
+            other._d = None
+            return other
+        if isinstance(other, NDArray):
+            return NDArray.copyto(self.todense(), other)
+        if isinstance(other, Context):
+            vals = jax.device_put(self._values, other.jax_device())
+            aux = [jax.device_put(a, other.jax_device()) for a in self._aux]
+            return type(self)(vals, aux, self._shape, other)
+        raise MXNetError(f"copyto does not support type {type(other)}")
+
+    def copy(self):
+        return type(self)(self._values, list(self._aux), self._shape, self._ctx)
+
+    def wait_to_read(self):
+        import jax
+
+        jax.block_until_ready(self._values)
+
+    # --- unsupported dense conveniences (reference parity) ----------------
+    def __iadd__(self, other):
+        raise MXNetError("SparseNDArray doesn't support in-place add")
+
+    def __isub__(self, other):
+        raise MXNetError("SparseNDArray doesn't support in-place sub")
+
+    def __imul__(self, other):
+        raise MXNetError("SparseNDArray doesn't support in-place mul")
+
+    def __itruediv__(self, other):
+        raise MXNetError("SparseNDArray doesn't support in-place div")
+
+    def reshape(self, *a, **kw):
+        raise MXNetError("reshape is not supported for SparseNDArray")
+
+    def broadcast_to(self, *a, **kw):
+        raise MXNetError("broadcast_to is not supported for SparseNDArray")
+
+    @property
+    def T(self):
+        raise MXNetError("transpose is not supported for SparseNDArray")
+
+    def __setitem__(self, key, value):
+        if not (
+            key is Ellipsis
+            or (isinstance(key, builtins.slice) and key == builtins.slice(None))
+        ):
+            raise MXNetError("SparseNDArray only supports [:] assignment")
+        if isinstance(value, BaseSparseNDArray):
+            value.copyto(self)
+        elif isinstance(value, NDArray):
+            self._set_from_dense(value._data)
+        elif isinstance(value, (np.ndarray, np.generic)):
+            self._set_from_dense(_asjax(np.asarray(value, dtype=self.dtype)))
+        else:
+            raise MXNetError(f"cannot assign type {type(value)} to SparseNDArray")
+
+    def __repr__(self):
+        return (
+            f"{self.asnumpy()!r}\n<{type(self).__name__} "
+            f"{'x'.join(map(str, self.shape))} @{self.context}>"
+        )
+
+    def __reduce__(self):  # pickle support
+        return (_unpickle_sparse, (self.stype, self.asnumpy()))
+
+
+def _unpickle_sparse(stype, dense_np):
+    return cast_storage(_dense_array(dense_np), stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse array: ``values[i] == dense[indices[i]]`` for the stored
+    rows, all other rows zero. aux = [int64 ``indices`` of length nnr], kept
+    sorted and unique (reference kRowSparseStorage, ndarray.h:105-180)."""
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        return NDArray(self._aux[0], self._ctx)
+
+    def _to_dense_jax(self):
+        import jax.numpy as jnp
+
+        dense = jnp.zeros(self._shape, self.dtype)
+        if int(self._aux[0].shape[0]) == 0:
+            return dense
+        return dense.at[self._aux[0]].set(self._values)
+
+    def _set_from_dense(self, dense):
+        rsp = _dense_to_rsp(dense)
+        self._values, self._aux = rsp._values, rsp._aux
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed-sparse-row matrix. aux = [int64 ``indptr`` (m+1), int64
+    ``indices`` (nnz)]; values is the flat nnz buffer (reference kCSRStorage,
+    ndarray.h:105-180)."""
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indices(self):
+        return NDArray(self._aux[1], self._ctx)
+
+    @property
+    def indptr(self):
+        return NDArray(self._aux[0], self._ctx)
+
+    def _row_ids(self):
+        """int32 row id per stored element — the coordinate form XLA's
+        segment/scatter primitives want."""
+        import jax.numpy as jnp
+
+        indptr = self._aux[0]
+        nnz = int(self._aux[1].shape[0])
+        if nnz == 0:
+            return jnp.zeros((0,), "int32")
+        # searchsorted turns the prefix-sum indptr into per-element rows
+        return (
+            jnp.searchsorted(indptr, jnp.arange(nnz, dtype=indptr.dtype), side="right")
+            - 1
+        ).astype("int32")
+
+    def _to_dense_jax(self):
+        import jax.numpy as jnp
+
+        dense = jnp.zeros(self._shape, self.dtype)
+        if int(self._aux[1].shape[0]) == 0:
+            return dense
+        rows = self._row_ids()
+        cols = self._aux[1].astype("int32")
+        return dense.at[rows, cols].set(self._values)
+
+    def _set_from_dense(self, dense):
+        csr_arr = _dense_to_csr(dense)
+        self._values, self._aux = csr_arr._values, csr_arr._aux
+
+    def __getitem__(self, key):
+        if isinstance(key, builtins.slice):
+            if key.step is not None:
+                raise MXNetError("CSRNDArray only supports continuous slicing")
+            if key.start is None and key.stop is None:
+                return self
+            return self._slice(key.start, key.stop)
+        raise MXNetError("CSRNDArray only supports row slicing")
+
+    def _slice(self, start, stop):
+        start = 0 if start is None else int(start)
+        stop = self.shape[0] if stop is None else int(stop)
+        indptr = np.asarray(self._aux[0])
+        lo, hi = int(indptr[start]), int(indptr[stop])
+        return CSRNDArray(
+            self._values[lo:hi],
+            [
+                _asjax(indptr[start : stop + 1] - indptr[start]),
+                self._aux[1][lo:hi],
+            ],
+            (stop - start,) + self.shape[1:],
+            self._ctx,
+        )
+
+
+# ---------------------------------------------------------------------------
+# constructors (reference sparse_ndarray.py:445-563)
+# ---------------------------------------------------------------------------
+def row_sparse(values, indices, shape, ctx=None, dtype=None, indices_type=None):
+    """Create a RowSparseNDArray from (nnr, ...) values + (nnr,) row indices."""
+    vals = _asjax(values, dtype)
+    idx = _asjax(indices, indices_type or np.int32)
+    if vals.ndim < 1 or idx.ndim != 1 or int(vals.shape[0]) != int(idx.shape[0]):
+        raise MXNetError(
+            f"row_sparse: values {tuple(vals.shape)} / indices "
+            f"{tuple(idx.shape)} mismatch"
+        )
+    return RowSparseNDArray(vals, [idx.astype(np.int32)], shape, ctx)
+
+
+def csr(values, indptr, indices, shape, ctx=None, dtype=None,
+        indptr_type=None, indices_type=None):
+    """Create a CSRNDArray from flat values + indptr + column indices."""
+    vals = _asjax(values, dtype).reshape(-1)
+    ptr = _asjax(indptr, indptr_type or np.int32).reshape(-1).astype(np.int32)
+    idx = _asjax(indices, indices_type or np.int32).reshape(-1).astype(np.int32)
+    if int(ptr.shape[0]) != int(shape[0]) + 1:
+        raise MXNetError(f"csr: indptr length {ptr.shape[0]} != rows+1")
+    if int(idx.shape[0]) != int(vals.shape[0]):
+        raise MXNetError("csr: indices/values length mismatch")
+    return CSRNDArray(vals, [ptr, idx], shape, ctx)
+
+
+def zeros(storage_type, shape, ctx=None, dtype=None):
+    """All-zero sparse array (nnz = 0)."""
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    dt = np_dtype(dtype)
+    if storage_type == "row_sparse":
+        return RowSparseNDArray(
+            jnp.zeros((0,) + tuple(shape[1:]), dt),
+            [jnp.zeros((0,), np.int32)],
+            shape,
+            ctx,
+        )
+    if storage_type == "csr":
+        if len(shape) != 2:
+            raise MXNetError("csr arrays must be 2-D")
+        return CSRNDArray(
+            jnp.zeros((0,), dt),
+            [jnp.zeros((shape[0] + 1,), np.int32), jnp.zeros((0,), np.int32)],
+            shape,
+            ctx,
+        )
+    if storage_type == "default":
+        return _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError(f"unknown storage type {storage_type!r}")
+
+
+def todense(source):
+    """Dense NDArray with the same value (reference ``mx.sparse_nd.todense``)."""
+    if isinstance(source, BaseSparseNDArray):
+        return source.todense()
+    return source
+
+
+# ---------------------------------------------------------------------------
+# cast_storage (reference src/operator/nn/cast_storage-inl.h)
+# ---------------------------------------------------------------------------
+def _dense_to_rsp(dense):
+    """Host-structured: nnr depends on data, so the row scan runs on host —
+    same as the reference's CPU CastStorageDnsRspImpl; the values gather
+    stays on device."""
+    dn = np.asarray(dense)
+    nz_rows = np.where((dn != 0).reshape(dn.shape[0], -1).any(axis=1))[0]
+    vals = _asjax(dense)[_asjax(nz_rows.astype(np.int32))]
+    return RowSparseNDArray(
+        vals, [_asjax(nz_rows.astype(np.int32))], dn.shape
+    )
+
+
+def _dense_to_csr(dense):
+    dn = np.asarray(dense)
+    if dn.ndim != 2:
+        raise MXNetError("csr arrays must be 2-D")
+    rows, cols = np.nonzero(dn)
+    indptr = np.zeros(dn.shape[0] + 1, np.int32)
+    np.add.at(indptr[1:], rows, 1)
+    indptr = np.cumsum(indptr).astype(np.int32)
+    return CSRNDArray(
+        _asjax(dn[rows, cols]),
+        [_asjax(indptr), _asjax(cols.astype(np.int32))],
+        dn.shape,
+    )
+
+
+def cast_storage(arr, storage_type):
+    """Convert between storage types (dense <-> row_sparse/csr)."""
+    if storage_type == "default":
+        return todense(arr) if isinstance(arr, BaseSparseNDArray) else arr
+    if isinstance(arr, BaseSparseNDArray):
+        if arr.stype == storage_type:
+            return arr
+        arr = arr.todense()
+    if storage_type == "row_sparse":
+        return _dense_to_rsp(arr._data)
+    if storage_type == "csr":
+        return _dense_to_csr(arr._data)
+    raise MXNetError(f"unknown storage type {storage_type!r}")
+
+
+# ---------------------------------------------------------------------------
+# sparse-aware kernels
+# ---------------------------------------------------------------------------
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot. csr·dense lowers to gather + segment_sum (one MXU-
+    friendly contraction per stored element group); cf. reference DotCsrDnsDns
+    (``src/operator/tensor/matrix_op.cc`` FComputeEx)."""
+    import jax.numpy as jnp
+    import jax.ops
+
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_b:
+            raise MXNetError("dot(csr, dense): transpose_b unsupported")
+        vals = lhs._values
+        cols = lhs._aux[1].astype("int32")
+        rows = lhs._row_ids()
+        r = rhs._data
+        if not transpose_a:
+            # out[i, :] = sum_k csr[i, k] * rhs[k, :]
+            gathered = r[cols] * vals[:, None]
+            out = jax.ops.segment_sum(gathered, rows, num_segments=lhs.shape[0])
+        else:
+            # out[k, :] = sum_i csr[i, k] * rhs[i, :]
+            gathered = r[rows] * vals[:, None]
+            out = jnp.zeros((lhs.shape[1], r.shape[1]), vals.dtype).at[cols].add(
+                gathered
+            )
+        return NDArray(out)
+    # dense fallback (incl. row_sparse lhs/rhs: densify)
+    a = todense(lhs)._data if isinstance(lhs, BaseSparseNDArray) else lhs._data
+    b = todense(rhs)._data if isinstance(rhs, BaseSparseNDArray) else rhs._data
+    if transpose_a:
+        a = a.T
+    if transpose_b:
+        b = b.T
+    return NDArray(jnp.dot(a, b))
+
+
+def sparse_retain(rsp, indices):
+    """Retain only the given rows of a row_sparse array (reference
+    ``sparse_retain`` op, src/operator/tensor/sparse_retain-inl.h)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("sparse_retain expects a RowSparseNDArray")
+    want = np.asarray(
+        indices.asnumpy() if isinstance(indices, NDArray) else indices
+    ).astype(np.int32)
+    have = np.asarray(rsp._aux[0])
+    keep = np.isin(have, want)
+    sel = _asjax(np.where(keep)[0].astype(np.int32))
+    return RowSparseNDArray(
+        rsp._values[sel], [rsp._aux[0][sel]], rsp.shape, rsp._ctx
+    )
+
+
+def elemwise_add(lhs, rhs):
+    """rsp + rsp -> rsp (union of rows); any dense operand -> dense."""
+    import jax.numpy as jnp
+
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        if lhs.shape != rhs.shape:
+            raise MXNetError("elemwise_add: shape mismatch")
+        li = np.asarray(lhs._aux[0])
+        ri = np.asarray(rhs._aux[0])
+        union = np.union1d(li, ri).astype(np.int32)
+        # union1d output is sorted, so positions come from one vectorized
+        # searchsorted per operand; the adds stay on device.
+        vals = jnp.zeros((len(union),) + lhs.shape[1:], lhs.dtype)
+        if len(li):
+            vals = vals.at[_asjax(np.searchsorted(union, li).astype(np.int32))].add(
+                lhs._values
+            )
+        if len(ri):
+            vals = vals.at[_asjax(np.searchsorted(union, ri).astype(np.int32))].add(
+                rhs._values
+            )
+        return RowSparseNDArray(vals, [_asjax(union)], lhs.shape)
+    a = todense(lhs) if isinstance(lhs, BaseSparseNDArray) else lhs
+    b = todense(rhs) if isinstance(rhs, BaseSparseNDArray) else rhs
+    return a + b
+
+
+# ---------------------------------------------------------------------------
+# row_sparse optimizer updates (reference src/operator/optimizer_op-inl.h
+# SGDDnsRspImpl / SGDMomDnsRspImpl / AdamDnsRspImpl): touch only stored rows.
+# ---------------------------------------------------------------------------
+def _prep_rows(weight, grad, rescale_grad, clip_gradient, wd):
+    import jax.numpy as jnp
+
+    idx = grad._aux[0]
+    g = grad._values * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w_rows = weight._data[idx]
+    if wd:
+        g = g + wd * w_rows
+    return idx, g, w_rows
+
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    idx, g, w_rows = _prep_rows(weight, grad, rescale_grad, clip_gradient, wd)
+    weight._data = weight._data.at[idx].set(w_rows - lr * g)
+    return weight
+
+
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    idx, g, w_rows = _prep_rows(weight, grad, rescale_grad, clip_gradient, wd)
+    m_rows = momentum * mom._data[idx] - lr * g
+    mom._data = mom._data.at[idx].set(m_rows)
+    weight._data = weight._data.at[idx].set(w_rows + m_rows)
+    return weight
+
+
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    import jax.numpy as jnp
+
+    # reference AdamUpdate: grad = rescale*grad + wd*weight, THEN clip
+    idx = grad._aux[0]
+    g = grad._values * rescale_grad
+    w_rows = weight._data[idx]
+    if wd:
+        g = g + wd * w_rows
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m_rows = beta1 * mean._data[idx] + (1 - beta1) * g
+    v_rows = beta2 * var._data[idx] + (1 - beta2) * g * g
+    mean._data = mean._data.at[idx].set(m_rows)
+    var._data = var._data.at[idx].set(v_rows)
+    weight._data = weight._data.at[idx].set(
+        w_rows - lr * m_rows / (jnp.sqrt(v_rows) + epsilon)
+    )
+    return weight
+
+
+def _storage_type(arr):
+    return arr.stype if isinstance(arr, NDArray) else "default"

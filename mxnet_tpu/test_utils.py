@@ -117,9 +117,21 @@ def assert_allclose(a, b, rtol=1e-5, atol=1e-20):
 
 
 def rand_ndarray(shape, stype="default", density=None, dtype=None):
-    if stype != "default":
-        raise MXNetError("sparse storage not supported in this build yet")
-    return array(_rng.randn(*shape).astype(dtype or default_dtype))
+    """Random dense or sparse NDArray (reference test_utils.py:106)."""
+    if stype == "default":
+        return array(_rng.randn(*shape).astype(dtype or default_dtype))
+    from .sparse_ndarray import cast_storage
+
+    density = 0.5 if density is None else density
+    dn = _rng.randn(*shape).astype(dtype or default_dtype)
+    if stype == "row_sparse":
+        mask = _rng.rand(shape[0]) < density
+        dn[~mask] = 0
+    elif stype == "csr":
+        dn[_rng.rand(*shape) >= density] = 0
+    else:
+        raise MXNetError(f"unknown stype {stype!r}")
+    return cast_storage(array(dn), stype)
 
 
 def _parse_location(sym, location, ctx=None):

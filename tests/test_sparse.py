@@ -1,0 +1,310 @@
+"""Sparse NDArray + sparse kernels.
+
+Modeled on the reference's ``tests/python/unittest/test_sparse_ndarray.py``
+and ``test_sparse_operator.py`` (sparse branch merged into 0.10.1).
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sparse_ndarray as sp
+from mxnet_tpu.test_utils import assert_almost_equal, rand_ndarray
+
+
+def _rsp_fixture(shape=(6, 3)):
+    dense = np.zeros(shape, np.float32)
+    rows = np.array([0, 2, 5])[: min(3, shape[0])]
+    rng = np.random.RandomState(0)
+    dense[rows] = rng.randn(len(rows), *shape[1:]).astype(np.float32)
+    return dense, rows
+
+
+def test_rsp_creation_and_dense():
+    dense, rows = _rsp_fixture()
+    arr = sp.row_sparse(dense[rows], rows, dense.shape)
+    assert arr.stype == "row_sparse"
+    assert arr.shape == dense.shape
+    assert_almost_equal(arr.asnumpy(), dense)
+    assert arr.indices.dtype == np.int32
+    assert_almost_equal(arr.indices.asnumpy(), rows)
+    assert_almost_equal(arr.values.asnumpy(), dense[rows])
+
+
+def test_csr_creation_and_dense():
+    dense = np.array([[1, 0, 2], [0, 0, 3], [4, 5, 6]], np.float32)
+    indptr = [0, 2, 3, 6]
+    indices = [0, 2, 2, 0, 1, 2]
+    values = [1, 2, 3, 4, 5, 6]
+    arr = sp.csr(values, indptr, indices, (3, 3))
+    assert arr.stype == "csr"
+    assert_almost_equal(arr.asnumpy(), dense)
+    assert arr.indptr.dtype == np.int32
+    assert arr.indices.dtype == np.int32
+
+
+def test_sparse_zeros():
+    z = sp.zeros("row_sparse", (4, 5))
+    assert z.shape == (4, 5) and z.asnumpy().sum() == 0
+    z = sp.zeros("csr", (4, 5))
+    assert z.shape == (4, 5) and z.asnumpy().sum() == 0
+    with pytest.raises(mx.MXNetError):
+        sp.zeros("csr", (2, 3, 4))
+
+
+def test_cast_storage_roundtrip():
+    rng = np.random.RandomState(1)
+    for shape in [(5, 4), (8, 3)]:
+        dn = rng.randn(*shape).astype(np.float32)
+        dn[rng.rand(*shape) > 0.5] = 0
+        dense = mx.nd.array(dn)
+        for stype in ("row_sparse", "csr"):
+            s = mx.nd.cast_storage(dense, stype)
+            assert s.stype == stype
+            assert_almost_equal(s.asnumpy(), dn)
+            back = mx.nd.cast_storage(s, "default")
+            assert back.stype == "default"
+            assert_almost_equal(back.asnumpy(), dn)
+
+
+def test_csr_slice():
+    dense = np.arange(12, dtype=np.float32).reshape(4, 3)
+    dense[1] = 0
+    arr = sp.cast_storage(mx.nd.array(dense), "csr")
+    sl = arr[1:3]
+    assert sl.shape == (2, 3)
+    assert_almost_equal(sl.asnumpy(), dense[1:3])
+
+
+def test_sparse_nd_setitem():
+    dense, rows = _rsp_fixture()
+    dst = sp.zeros("row_sparse", dense.shape)
+    dst[:] = sp.row_sparse(dense[rows], rows, dense.shape)
+    assert_almost_equal(dst.asnumpy(), dense)
+    dst2 = sp.zeros("row_sparse", (3, 3))
+    dst2[:] = mx.nd.ones((3, 3))
+    assert_almost_equal(dst2.asnumpy(), np.ones((3, 3)))
+    with pytest.raises(mx.MXNetError):
+        dst2[1:2] = mx.nd.ones((1, 3))
+
+
+def test_sparse_elemwise_add():
+    a_dn = np.zeros((5, 2), np.float32)
+    b_dn = np.zeros((5, 2), np.float32)
+    a_dn[[0, 3]] = 1.5
+    b_dn[[3, 4]] = 2.5
+    a = sp.cast_storage(mx.nd.array(a_dn), "row_sparse")
+    b = sp.cast_storage(mx.nd.array(b_dn), "row_sparse")
+    out = mx.nd.elemwise_add(a, b)
+    assert out.stype == "row_sparse"
+    assert_almost_equal(out.asnumpy(), a_dn + b_dn)
+    # mixed -> dense
+    out2 = mx.nd.elemwise_add(a, mx.nd.array(b_dn))
+    assert out2.stype == "default"
+    assert_almost_equal(out2.asnumpy(), a_dn + b_dn)
+
+
+def test_sparse_nd_binary_dense_fallback():
+    # any dense op works on sparse handles through the dense fallback
+    dense, rows = _rsp_fixture()
+    arr = sp.row_sparse(dense[rows], rows, dense.shape)
+    out = arr * 2 + 1
+    assert_almost_equal(out.asnumpy(), dense * 2 + 1)
+    neg = -arr
+    assert_almost_equal(neg.asnumpy(), -dense)
+
+
+def test_sparse_dot_csr_dense():
+    rng = np.random.RandomState(2)
+    lhs_dn = rng.randn(4, 6).astype(np.float32)
+    lhs_dn[rng.rand(4, 6) > 0.4] = 0
+    rhs = rng.randn(6, 5).astype(np.float32)
+    lhs = sp.cast_storage(mx.nd.array(lhs_dn), "csr")
+    out = mx.nd.dot(lhs, mx.nd.array(rhs))
+    assert_almost_equal(out.asnumpy(), lhs_dn.dot(rhs), rtol=1e-5, atol=1e-5)
+    # transpose_a: out[k,:] = sum_i lhs[i,k] rhs[i,:]
+    rhs_t = rng.randn(4, 5).astype(np.float32)
+    out_t = mx.nd.dot(lhs, mx.nd.array(rhs_t), transpose_a=True)
+    assert_almost_equal(out_t.asnumpy(), lhs_dn.T.dot(rhs_t), rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_retain():
+    dense, rows = _rsp_fixture()
+    arr = sp.row_sparse(dense[rows], rows, dense.shape)
+    keep = mx.nd.array(np.array([0, 5], np.float32))
+    out = mx.nd.sparse_retain(arr, keep)
+    expect = np.zeros_like(dense)
+    expect[[0, 5]] = dense[[0, 5]]
+    assert out.stype == "row_sparse"
+    assert_almost_equal(out.asnumpy(), expect)
+
+
+def test_sparse_sgd_update_matches_dense():
+    rng = np.random.RandomState(3)
+    w0 = rng.randn(6, 4).astype(np.float32)
+    g_dn = np.zeros((6, 4), np.float32)
+    g_dn[[1, 4]] = rng.randn(2, 4).astype(np.float32)
+    grad = sp.cast_storage(mx.nd.array(g_dn), "row_sparse")
+
+    w_sparse = mx.nd.array(w0)
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.01,
+                              rescale_grad=0.5)
+    state = opt.create_state(0, w_sparse)
+    opt.update(0, w_sparse, grad, state)
+
+    w_dense = mx.nd.array(w0)
+    opt2 = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.01,
+                               rescale_grad=0.5)
+    state2 = opt2.create_state(0, w_dense)
+    opt2.update(0, w_dense, mx.nd.array(g_dn), state2)
+
+    # rows with gradient must match the dense update exactly
+    assert_almost_equal(
+        w_sparse.asnumpy()[[1, 4]], w_dense.asnumpy()[[1, 4]], rtol=1e-5, atol=1e-6
+    )
+    # untouched rows must be untouched (lazy update semantics of the sparse
+    # kernel — dense applies wd decay everywhere, sparse only where grads are)
+    assert_almost_equal(w_sparse.asnumpy()[[0, 2, 3, 5]], w0[[0, 2, 3, 5]])
+
+
+def test_sparse_adam_update_matches_dense():
+    rng = np.random.RandomState(4)
+    w0 = rng.randn(5, 3).astype(np.float32)
+    g_dn = np.zeros((5, 3), np.float32)
+    g_dn[[0, 2]] = rng.randn(2, 3).astype(np.float32)
+    grad = sp.cast_storage(mx.nd.array(g_dn), "row_sparse")
+
+    w_s = mx.nd.array(w0)
+    opt = mx.optimizer.create("adam", learning_rate=0.01)
+    st = opt.create_state(0, w_s)
+    opt.update(0, w_s, grad, st)
+
+    w_d = mx.nd.array(w0)
+    opt2 = mx.optimizer.create("adam", learning_rate=0.01)
+    st2 = opt2.create_state(0, w_d)
+    opt2.update(0, w_d, mx.nd.array(g_dn), st2)
+
+    assert_almost_equal(w_s.asnumpy()[[0, 2]], w_d.asnumpy()[[0, 2]],
+                        rtol=1e-5, atol=1e-6)
+    assert_almost_equal(w_s.asnumpy()[[1, 3, 4]], w0[[1, 3, 4]])
+
+
+def test_sparse_kvstore_push_pull():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((6, 2)))
+    g1 = sp.row_sparse(np.ones((2, 2), np.float32), [0, 3], (6, 2))
+    g2 = sp.row_sparse(np.ones((2, 2), np.float32) * 2, [3, 5], (6, 2))
+    kv.push("w", [g1, g2])
+    out = mx.nd.zeros((6, 2))
+    kv.pull("w", out=out)
+    expect = np.zeros((6, 2), np.float32)
+    expect[0] = 1
+    expect[3] = 3
+    expect[5] = 2
+    assert_almost_equal(out.asnumpy(), expect)
+
+    # row_sparse_pull fetches only requested rows
+    dst = sp.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("w", out=dst, row_ids=mx.nd.array([3, 5]))
+    got = dst.asnumpy()
+    assert_almost_equal(got[[3, 5]], expect[[3, 5]])
+    assert got[[0, 1, 2, 4]].sum() == 0
+
+
+def test_sparse_pickle_save_load(tmp_path):
+    arr = rand_ndarray((6, 4), "row_sparse")
+    blob = pickle.dumps(arr)
+    back = pickle.loads(blob)
+    assert back.stype == "row_sparse"
+    assert_almost_equal(back.asnumpy(), arr.asnumpy())
+
+    fname = str(tmp_path / "sparse.params")
+    csr_arr = rand_ndarray((5, 7), "csr")
+    mx.nd.save(fname, {"rsp": arr, "csr": csr_arr, "dn": mx.nd.ones((2, 2))})
+    loaded = mx.nd.load(fname)
+    assert loaded["rsp"].stype == "row_sparse"
+    assert loaded["csr"].stype == "csr"
+    assert loaded["dn"].stype == "default"
+    assert_almost_equal(loaded["rsp"].asnumpy(), arr.asnumpy())
+    assert_almost_equal(loaded["csr"].asnumpy(), csr_arr.asnumpy())
+
+
+def test_libsvm_iter(tmp_path):
+    fname = str(tmp_path / "data.libsvm")
+    with open(fname, "w") as f:
+        f.write("1 0:1.5 3:2.5\n")
+        f.write("0 1:0.5\n")
+        f.write("1 2:1.0 3:3.0\n")
+        f.write("0 0:4.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=fname, data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    b0 = batches[0]
+    assert b0.data[0].stype == "csr"
+    assert b0.data[0].shape == (2, 4)
+    expect0 = np.array([[1.5, 0, 0, 2.5], [0, 0.5, 0, 0]], np.float32)
+    assert_almost_equal(b0.data[0].asnumpy(), expect0)
+    assert_almost_equal(b0.label[0].asnumpy(), np.array([1, 0], np.float32))
+    it.reset()
+    again = list(it)
+    assert_almost_equal(again[0].data[0].asnumpy(), expect0)
+
+
+def test_libsvm_iter_pads_partial_batch(tmp_path):
+    fname = str(tmp_path / "small.libsvm")
+    with open(fname, "w") as f:
+        f.write("1 0:1.0\n")
+        f.write("0 2:2.0\n")
+        f.write("1 1:3.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=fname, data_shape=(3,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].pad == 0
+    assert batches[1].pad == 1
+    got = batches[1].data[0].asnumpy()
+    assert_almost_equal(got[0], np.array([0, 3.0, 0], np.float32))
+    assert got[1].sum() == 0  # padded row is all-zero
+    # dataset smaller than batch_size still yields one (padded) batch
+    it2 = mx.io.LibSVMIter(data_libsvm=fname, data_shape=(3,), batch_size=8)
+    b = list(it2)
+    assert len(b) == 1 and b[0].pad == 5
+
+
+def test_sparse_embedding_grad_pattern():
+    """Embedding-style workload: dense grad -> row_sparse -> sparse update.
+
+    The reference's sparse embedding test checks that only looked-up rows
+    change (test_sparse_operator.py:135); here the tape produces a dense
+    grad and cast_storage recovers the row-sparse structure for the update.
+    """
+    vocab, dim = 8, 3
+    rng = np.random.RandomState(5)
+    w0 = rng.randn(vocab, dim).astype(np.float32)
+    idx = np.array([1, 1, 6], np.float32)
+
+    data = mx.sym.Variable("data")
+    weight = mx.sym.Variable("embed_weight")
+    embed = mx.sym.Embedding(data=data, weight=weight, input_dim=vocab,
+                             output_dim=dim, name="embed")
+    loss = mx.sym.make_loss(mx.sym.sum(embed))
+    exe = loss.simple_bind(mx.cpu(), data=(3,), grad_req={"embed_weight": "write"})
+    exe.arg_dict["data"][:] = mx.nd.array(idx)
+    exe.arg_dict["embed_weight"][:] = mx.nd.array(w0)
+    exe.forward(is_train=True)
+    exe.backward()
+    g = exe.grad_dict["embed_weight"]
+    g_rsp = mx.nd.cast_storage(g, "row_sparse")
+    touched = set(g_rsp.indices.asnumpy().astype(int).tolist())
+    assert touched == {1, 6}
+
+    w = mx.nd.array(w0)
+    opt = mx.optimizer.create("sgd", learning_rate=1.0)
+    opt.update(0, w, g_rsp, None)
+    out = w.asnumpy()
+    expect = w0.copy()
+    expect[1] -= 2.0  # index 1 looked up twice, d(sum)/d(row) = count
+    expect[6] -= 1.0
+    assert_almost_equal(out, expect, rtol=1e-5, atol=1e-6)
