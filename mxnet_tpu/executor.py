@@ -48,10 +48,17 @@ def _fold_rng(rng):
 
 
 class _CompiledGraph:
-    """The symbol lowered to a pure function over ordered value lists."""
+    """The symbol lowered to a pure function over ordered value lists.
 
-    def __init__(self, symbol):
+    ``node2dev`` (optional) maps ``id(node)`` → jax device for ctx-group
+    model parallelism: values crossing into a placed node are moved with
+    ``jax.device_put`` — the analogue of the reference's ``_CrossDeviceCopy``
+    nodes inserted by the PlaceDevice pass (graph_executor.cc:286-385).
+    """
+
+    def __init__(self, symbol, node2dev=None):
         self.symbol = symbol
+        self.node2dev = node2dev or {}
         self.topo = symbol._topo()
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -82,6 +89,13 @@ class _CompiledGraph:
                 continue
             params = node.params()
             ins = [env[id(inode)][idx] for (inode, idx) in node.inputs]
+            dev = self.node2dev.get(id(node))
+            if dev is not None:
+                # cross-device edge: move operands onto this node's device
+                # (device_put is a no-op when already there, and its vjp
+                # transposes the copy so gradients flow back to the source
+                # device — the backward _CrossDeviceCopy of the reference)
+                ins = [jax.device_put(x, dev) for x in ins]
             node_rng = None
             if node.op.need_rng:
                 node_rng = jax.random.fold_in(rng, self._rng_serial[id(node)])
@@ -110,7 +124,8 @@ class Executor:
                  in_shardings=None):
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
-        self.graph = _CompiledGraph(symbol)
+        self._node2dev = self._place_nodes(symbol, group2ctx)
+        self.graph = _CompiledGraph(symbol, node2dev=self._node2dev)
         self.arg_names = self.graph.arg_names
         self.aux_names = self.graph.aux_names
         self.output_names = symbol.list_outputs()
@@ -164,6 +179,36 @@ class Executor:
             self._jit_cache = shared_exec._jit_cache
 
     # ------------------------------------------------------------------
+    def _place_nodes(self, symbol, group2ctx):
+        """Lower ctx_group annotations to a node→device placement map
+        (the PlaceDevice pass, reference graph_executor.cc:286-385).
+
+        Returns {} when no annotated node maps to a known group — the graph
+        then compiles as one single-device XLA program. With placement the
+        graph runs un-jitted: each op dispatches on its assigned device
+        (jax computation-follows-data ≈ the reference's per-device engine
+        queues) with device_put transfers at group boundaries. Unannotated
+        op nodes get the bind context (reference AssignContext default), so
+        a node joining two groups always has a device to copy operands to.
+        """
+        if not group2ctx:
+            return {}
+        out = {}
+        topo = symbol._topo()
+        for node in topo:
+            grp = node.attrs.get("ctx_group")
+            if grp is None or node.is_variable:
+                continue
+            ctx = group2ctx.get(grp)
+            if ctx is not None:
+                out[id(node)] = ctx.jax_device()
+        if out:
+            default_dev = self._ctx.jax_device()
+            for node in topo:
+                if not node.is_variable and id(node) not in out:
+                    out[id(node)] = default_dev
+        return out
+
     def _norm_arrays(self, arrays, names, what, allow_missing=False):
         if arrays is None:
             if allow_missing:
@@ -256,9 +301,14 @@ class Executor:
                 )
                 return outs, aux_upd
 
-            fn = jax.jit(_fwd)
+            fn = _fwd if self._node2dev else jax.jit(_fwd)
         elif kind == "train_step":
-            fn = jax.jit(self._make_grad_core())
+            core = self._make_grad_core()
+            # ctx-group placement spans devices: XLA compiles single-device
+            # (or SPMD-sharded) programs only, so a placed graph executes
+            # eagerly — per-op dispatch on the op's device, like the
+            # reference engine's per-device worker queues
+            fn = core if self._node2dev else jax.jit(core)
         else:
             raise MXNetError(f"unknown jit kind {kind}")
         self._jit_cache[cache_key] = fn
@@ -487,6 +537,12 @@ class Executor:
             raise MXNetError(
                 "fused_train_update requires a pending backward(); gradients "
                 "were already materialised — use the per-param update path"
+            )
+        if self._node2dev:
+            raise MXNetError(
+                "fused_train_update unsupported with ctx-group placement "
+                "(multi-device graph cannot be one donated program); use the "
+                "imperative update path"
             )
         head_grads = self._bwd_heads
         with_hg = head_grads is not None

@@ -510,7 +510,13 @@ class LibSVMIter(DataIter):
                 labels.append(float(parts[0]))
                 for tok in parts[1:]:
                     i, v = tok.split(":")
-                    cols.append(int(i))
+                    col = int(i)
+                    if col >= self.data_shape[0]:
+                        raise MXNetError(
+                            f"{path}:{len(indptr)}: feature index {col} out "
+                            f"of range for data_shape {self.data_shape}"
+                        )
+                    cols.append(col)
                     vals.append(float(v))
                 indptr.append(len(vals))
         return (

@@ -109,23 +109,40 @@ class KVStore:
         """Pull only the requested rows of the stored value as row_sparse
         (reference ``KVStoreDist::PullRowSparse``, kvstore_dist.h:274-350 —
         workers ship row ids, servers respond with just those rows)."""
-        from .sparse_ndarray import RowSparseNDArray
+        from .sparse_ndarray import RowSparseNDArray, _asjax
         import numpy as np
 
         assert out is not None and row_ids is not None
         keys, outs = _key_value(key, out)
-        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids] * len(keys)
-        for k, o, rid in zip(keys, outs, rids):
+        if len(keys) == 1 and isinstance(outs[0], (list, tuple)):
+            # single key, per-device out list: row_ids pairs with out
+            # entry-by-entry (reference PullRowSparse ships one row-id set
+            # per destination, kvstore_dist.h:274-350)
+            targets = list(outs[0])
+            rids = (
+                list(row_ids) if isinstance(row_ids, (list, tuple))
+                else [row_ids] * len(targets)
+            )
+            if len(rids) != len(targets):
+                raise MXNetError(
+                    f"row_sparse_pull: {len(targets)} outs but "
+                    f"{len(rids)} row_ids"
+                )
+            pairs = [(keys[0], t, r) for t, r in zip(targets, rids)]
+        else:
+            rids = (
+                list(row_ids) if isinstance(row_ids, (list, tuple))
+                else [row_ids] * len(keys)
+            )
+            pairs = list(zip(keys, outs, rids))
+        for k, t, rid in pairs:
             src = self._store[k]
             rows = np.unique(np.asarray(rid.asnumpy(), np.int32))
-            vals = src._data[rows]
-            targets = o if isinstance(o, (list, tuple)) else [o]
-            for t in targets:
-                if not isinstance(t, RowSparseNDArray):
-                    raise MXNetError("row_sparse_pull needs row_sparse outs")
-                t._values = vals
-                t._aux = [_as_idx(rows)]
-                t._d = None
+            if not isinstance(t, RowSparseNDArray):
+                raise MXNetError("row_sparse_pull needs row_sparse outs")
+            t._values = src._data[rows]
+            t._aux = [_asjax(rows, np.int32)]
+            t._d = None
 
     # --- optimizer plane ----------------------------------------------
     def set_optimizer(self, optimizer):
@@ -244,9 +261,3 @@ def _updater_key(k):
         return int(k)
     except ValueError:
         return k
-
-
-def _as_idx(np_arr):
-    import jax.numpy as jnp
-
-    return jnp.asarray(np_arr.astype("int32"))

@@ -39,6 +39,13 @@ from ..base import MXNetError, np_dtype
 
 _REQUIRED = object()
 
+# Graph-level node attributes (AttrScope metadata consumed by the executor,
+# not op parameters) — the reference keeps these in the generic nnvm attr
+# dict: ctx_group drives PlaceDevice (graph_executor.cc:286-385), the others
+# feed optimizer/memory passes.
+_GRAPH_ATTRS = {"ctx_group", "lr_mult", "wd_mult", "force_mirroring",
+                "mirror_stage"}
+
 
 @dataclass(frozen=True)
 class OpMode:
@@ -146,7 +153,7 @@ class OpDef:
         for k in raw:
             if k not in self.param_schema and not (
                 k.startswith("__") and k.endswith("__")
-            ):
+            ) and k not in _GRAPH_ATTRS:
                 raise MXNetError(f"op {self.name}: unknown param {k!r}")
         return out
 

@@ -338,6 +338,11 @@ def csr(values, indptr, indices, shape, ctx=None, dtype=None,
         raise MXNetError(f"csr: indptr length {ptr.shape[0]} != rows+1")
     if int(idx.shape[0]) != int(vals.shape[0]):
         raise MXNetError("csr: indices/values length mismatch")
+    if idx.size and int(np.asarray(idx).max()) >= int(shape[1]):
+        raise MXNetError(
+            f"csr: column index {int(np.asarray(idx).max())} out of range "
+            f"for shape {tuple(shape)}"
+        )
     return CSRNDArray(vals, [ptr, idx], shape, ctx)
 
 
@@ -438,6 +443,11 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         cols = lhs._aux[1].astype("int32")
         rows = lhs._row_ids()
         r = rhs._data
+        vec = r.ndim == 1  # matrix·vector: lift to (k,1), squeeze after
+        if vec:
+            r = r[:, None]
+        if r.ndim != 2:
+            raise MXNetError("dot(csr, dense): rhs must be 1-D or 2-D")
         if not transpose_a:
             # out[i, :] = sum_k csr[i, k] * rhs[k, :]
             gathered = r[cols] * vals[:, None]
@@ -448,7 +458,7 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
             out = jnp.zeros((lhs.shape[1], r.shape[1]), vals.dtype).at[cols].add(
                 gathered
             )
-        return NDArray(out)
+        return NDArray(out[:, 0] if vec else out)
     # dense fallback (incl. row_sparse lhs/rhs: densify)
     a = todense(lhs)._data if isinstance(lhs, BaseSparseNDArray) else lhs._data
     b = todense(rhs)._data if isinstance(rhs, BaseSparseNDArray) else rhs._data

@@ -131,6 +131,44 @@ def test_sparse_dot_csr_dense():
     assert_almost_equal(out_t.asnumpy(), lhs_dn.T.dot(rhs_t), rtol=1e-5, atol=1e-5)
 
 
+def test_sparse_dot_csr_vector():
+    lhs = sp.csr([1.0, 2.0, 3.0], [0, 2, 3], [0, 2, 1], (2, 3))
+    v = mx.nd.array([1.0, 1.0, 1.0])
+    out = mx.nd.dot(lhs, v)
+    assert out.shape == (2,)
+    assert_almost_equal(out.asnumpy(), np.array([3.0, 3.0], np.float32))
+    out_t = mx.nd.dot(lhs, mx.nd.array([1.0, 2.0]), transpose_a=True)
+    assert out_t.shape == (3,)
+    assert_almost_equal(out_t.asnumpy(), lhs.asnumpy().T.dot([1.0, 2.0]))
+
+
+def test_csr_column_index_validation():
+    with pytest.raises(mx.MXNetError):
+        sp.csr([1.0], [0, 1], [7], (1, 4))
+
+
+def test_libsvm_rejects_out_of_range_feature(tmp_path):
+    fname = str(tmp_path / "bad.libsvm")
+    with open(fname, "w") as f:
+        f.write("1 0:1.0 7:9.0\n")
+    with pytest.raises(mx.MXNetError):
+        mx.io.LibSVMIter(data_libsvm=fname, data_shape=(4,), batch_size=1)
+
+
+def test_row_sparse_pull_per_device_row_ids():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.array(np.arange(12, dtype=np.float32).reshape(6, 2)))
+    a = sp.zeros("row_sparse", (6, 2))
+    b = sp.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull(
+        "w", out=[a, b], row_ids=[mx.nd.array([0, 1]), mx.nd.array([4, 5])]
+    )
+    assert_almost_equal(np.asarray(a.indices.asnumpy()), [0, 1])
+    assert_almost_equal(np.asarray(b.indices.asnumpy()), [4, 5])
+    assert_almost_equal(b.asnumpy()[5], [10, 11])
+    assert a.asnumpy()[4:].sum() == 0
+
+
 def test_sparse_retain():
     dense, rows = _rsp_fixture()
     arr = sp.row_sparse(dense[rows], rows, dense.shape)
