@@ -6,6 +6,38 @@ Import as ``import mxnet_tpu as mx``; the namespace mirrors the reference's
 ``mx.kv``, ``mx.metric``, ``mx.optimizer``, ``mx.init``, ``mx.rnn``, etc.
 """
 
+def _maybe_init_distributed():
+    """Join the multi-host jax runtime when launched by tools/launch.py.
+
+    Must run before anything initialises the XLA backend, so it lives at
+    package import — the analogue of the reference auto-entering the server
+    loop on import when DMLC_ROLE=server (python/mxnet/kvstore_server.py:58).
+    """
+    import os
+
+    coord = os.environ.get("MXNET_COORDINATOR")
+    nproc = int(os.environ.get("MXNET_NUM_PROCS", "1"))
+    proc_id = os.environ.get("MXNET_PROC_ID")
+    if coord and nproc > 1 and proc_id is not None:
+        import jax
+
+        try:
+            # (jax.process_count() would itself initialise the backend, so
+            # no pre-check — this is the first jax call in the process)
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=nproc,
+                process_id=int(proc_id),
+            )
+        except RuntimeError:
+            # the worker script (or another framework) already initialised
+            # the distributed runtime — fine, DistKVStore validates the
+            # process count when created
+            pass
+
+
+_maybe_init_distributed()
+
 from .base import MXNetError, __version__
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
 

@@ -1,0 +1,334 @@
+"""Detection-aware image pipeline: ImageDetRecordIter + box augmenter.
+
+Reference: ``src/io/iter_image_det_recordio.cc:563`` (ImageDetRecordIter)
+and ``src/io/image_det_aug_default.cc:25+`` (DefaultImageDetAugmenter).
+
+Record label layout (reference ImageDetLabelMap / im2rec detection packing):
+``[header_width, obj_width, <extra header...>, obj0..., obj1..., ...]`` where
+each object is ``[class_id, xmin, ymin, xmax, ymax, <extra...>]`` with
+coordinates normalised to [0, 1]. The iterator emits labels of shape
+``(batch, max_objects, obj_width)`` padded with -1 — the layout
+``MultiBoxTarget`` consumes.
+
+The augmenter applies the reference's box-aware transforms: random
+IOU-constrained crop (sampler list with min/max scale, aspect ratio and
+overlap, ``image_det_aug_default.cc`` RandomCropGenerator), random
+expansion pad, mirror (x-coords flipped), and force-resize to
+``data_shape`` — each transform updates box coordinates consistently.
+The decode/augment work runs in a host thread pool; the TPU only ever
+sees the final packed batch.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .base import MXNetError
+from .recordio import MXRecordIO, unpack
+
+_PAD = -1.0
+
+
+def pack_det_label(boxes, extra_header=(), obj_width=5):
+    """Build the flat detection label for ``recordio.pack_img``.
+
+    ``boxes``: (N, obj_width) array of [cls, xmin, ymin, xmax, ymax, ...],
+    coords normalised. Returns float32 1-D label array.
+    """
+    boxes = np.asarray(boxes, np.float32).reshape(-1, obj_width)
+    header = [2 + len(extra_header), obj_width] + list(extra_header)
+    return np.concatenate(
+        [np.asarray(header, np.float32), boxes.reshape(-1)]
+    )
+
+
+def _parse_det_label(flat):
+    flat = np.asarray(flat, np.float32).reshape(-1)
+    if flat.size < 2:
+        raise MXNetError("detection label too short (needs header)")
+    header_width = int(flat[0])
+    obj_width = int(flat[1])
+    body = flat[header_width:]
+    n = body.size // obj_width
+    return body[: n * obj_width].reshape(n, obj_width)
+
+
+def _iou(box, boxes):
+    """IOU of one [xmin,ymin,xmax,ymax] box against (N,4) boxes."""
+    x1 = np.maximum(box[0], boxes[:, 0])
+    y1 = np.maximum(box[1], boxes[:, 1])
+    x2 = np.minimum(box[2], boxes[:, 2])
+    y2 = np.minimum(box[3], boxes[:, 3])
+    inter = np.maximum(0, x2 - x1) * np.maximum(0, y2 - y1)
+    a1 = (box[2] - box[0]) * (box[3] - box[1])
+    a2 = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    union = a1 + a2 - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0)
+
+
+class DetAugmenter:
+    """Box-aware augmenter (reference DefaultImageDetAugmenter)."""
+
+    def __init__(self, data_shape, rand_crop_prob=0.0, min_crop_scales=(0.3,),
+                 max_crop_scales=(1.0,), min_crop_aspect_ratios=(0.75,),
+                 max_crop_aspect_ratios=(1.33,), min_crop_overlaps=(0.0,),
+                 max_crop_overlaps=(1.0,), max_crop_trials=(25,),
+                 num_crop_sampler=1, rand_pad_prob=0.0, max_pad_scale=4.0,
+                 rand_mirror_prob=0.0, fill_value=127, rng=None):
+        self.data_shape = tuple(data_shape)
+        self.rand_crop_prob = rand_crop_prob
+        self.samplers = [
+            dict(
+                min_scale=_at(min_crop_scales, i),
+                max_scale=_at(max_crop_scales, i),
+                min_aspect=_at(min_crop_aspect_ratios, i),
+                max_aspect=_at(max_crop_aspect_ratios, i),
+                min_overlap=_at(min_crop_overlaps, i),
+                max_overlap=_at(max_crop_overlaps, i),
+                max_trials=int(_at(max_crop_trials, i)),
+            )
+            for i in range(num_crop_sampler)
+        ]
+        self.rand_pad_prob = rand_pad_prob
+        self.max_pad_scale = max_pad_scale
+        self.rand_mirror_prob = rand_mirror_prob
+        self.fill_value = fill_value
+        self.rs = rng or np.random.RandomState(0)
+
+    # -- individual transforms (normalised coords throughout) -------------
+    def _sample_crop(self, boxes, rs=None):
+        """Pick an IOU-constrained crop window; None if sampling fails."""
+        rs = rs if rs is not None else self.rs
+        for sampler in self.samplers:
+            for _ in range(sampler["max_trials"]):
+                scale = rs.uniform(sampler["min_scale"], sampler["max_scale"])
+                ar = rs.uniform(sampler["min_aspect"], sampler["max_aspect"])
+                w = scale * np.sqrt(ar)
+                h = scale / np.sqrt(ar)
+                if w > 1 or h > 1:
+                    continue
+                x = rs.uniform(0, 1 - w)
+                y = rs.uniform(0, 1 - h)
+                win = np.array([x, y, x + w, y + h], np.float32)
+                if len(boxes) == 0:
+                    return win
+                ious = _iou(win, boxes[:, 1:5])
+                if ious.max() >= sampler["min_overlap"] and \
+                        ious.max() <= sampler["max_overlap"]:
+                    return win
+        return None
+
+    @staticmethod
+    def _crop_boxes(boxes, win):
+        """Keep boxes whose center is inside ``win``; re-normalise to it
+        (reference crop_emit_mode=0 'center' emission)."""
+        if len(boxes) == 0:
+            return boxes
+        cx = (boxes[:, 1] + boxes[:, 3]) / 2
+        cy = (boxes[:, 2] + boxes[:, 4]) / 2
+        keep = (cx >= win[0]) & (cx <= win[2]) & (cy >= win[1]) & (cy <= win[3])
+        out = boxes[keep].copy()
+        w, h = win[2] - win[0], win[3] - win[1]
+        out[:, 1] = np.clip((out[:, 1] - win[0]) / w, 0, 1)
+        out[:, 3] = np.clip((out[:, 3] - win[0]) / w, 0, 1)
+        out[:, 2] = np.clip((out[:, 2] - win[1]) / h, 0, 1)
+        out[:, 4] = np.clip((out[:, 4] - win[1]) / h, 0, 1)
+        return out
+
+    def __call__(self, img, boxes, rng=None):
+        import cv2
+
+        rs = rng if rng is not None else self.rs
+        # random expansion pad (reference rand_pad_prob/max_pad_scale)
+        if self.rand_pad_prob > 0 and rs.rand() < self.rand_pad_prob:
+            scale = rs.uniform(1.0, self.max_pad_scale)
+            ih, iw = img.shape[:2]
+            nh, nw = int(ih * scale), int(iw * scale)
+            y0 = rs.randint(0, nh - ih + 1)
+            x0 = rs.randint(0, nw - iw + 1)
+            canvas = np.full((nh, nw, 3), self.fill_value, img.dtype)
+            canvas[y0:y0 + ih, x0:x0 + iw] = img
+            img = canvas
+            if len(boxes):
+                boxes = boxes.copy()
+                boxes[:, 1] = (boxes[:, 1] * iw + x0) / nw
+                boxes[:, 3] = (boxes[:, 3] * iw + x0) / nw
+                boxes[:, 2] = (boxes[:, 2] * ih + y0) / nh
+                boxes[:, 4] = (boxes[:, 4] * ih + y0) / nh
+        # IOU-constrained random crop
+        if self.rand_crop_prob > 0 and rs.rand() < self.rand_crop_prob:
+            win = self._sample_crop(boxes, rs)
+            if win is not None:
+                ih, iw = img.shape[:2]
+                x1, y1 = int(win[0] * iw), int(win[1] * ih)
+                x2, y2 = int(np.ceil(win[2] * iw)), int(np.ceil(win[3] * ih))
+                img = img[y1:y2, x1:x2]
+                boxes = self._crop_boxes(boxes, win)
+        # mirror flips x coordinates
+        if self.rand_mirror_prob > 0 and rs.rand() < self.rand_mirror_prob:
+            img = img[:, ::-1]
+            if len(boxes):
+                boxes = boxes.copy()
+                x1 = 1.0 - boxes[:, 3]
+                boxes[:, 3] = 1.0 - boxes[:, 1]
+                boxes[:, 1] = x1
+        # force resize to data_shape (reference resize_mode=0)
+        c, h, w = self.data_shape
+        img = cv2.resize(img, (w, h))
+        return img, boxes
+
+
+def _at(tup, i):
+    tup = tup if isinstance(tup, (list, tuple)) else (tup,)
+    return tup[i] if i < len(tup) else tup[-1]
+
+
+class ImageDetRecordIter:
+    """RecordIO-backed detection iterator (reference ImageDetRecordIter).
+
+    Yields data (batch, C, H, W) and label (batch, max_objects, obj_width)
+    padded with -1, matching ``MultiBoxTarget``'s expected layout.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_pad_width=0,
+                 shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
+                 part_index=0, num_parts=1, preprocess_threads=4, seed=0,
+                 data_name="data", label_name="label", **aug_kwargs):
+        import cv2  # noqa: F401 — fail early if decode backend missing
+
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.array([std_r, std_g, std_b], np.float32)
+        self.scale = scale
+        self.data_name = data_name
+        self.label_name = label_name
+        self.shuffle = shuffle
+        self.rs = np.random.RandomState(seed)
+        self.aug = DetAugmenter(data_shape, rng=self.rs, **aug_kwargs)
+        self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+        self._lock = threading.Lock()
+
+        # scan offsets + find max object count / object width for padding
+        self._offsets = []
+        max_objs, obj_width = 0, 5
+        rec = MXRecordIO(path_imgrec, "r")
+        while True:
+            pos = rec.tell()
+            buf = rec.read()
+            if buf is None:
+                break
+            header, _ = unpack(buf)
+            boxes = _parse_det_label(header.label)
+            max_objs = max(max_objs, len(boxes))
+            if len(boxes):
+                obj_width = boxes.shape[1]
+            self._offsets.append(pos)
+        rec.close()
+        self.obj_width = obj_width
+        self.max_objs = max(max_objs, label_pad_width // obj_width if
+                            label_pad_width else 0, 1)
+        self._offsets = self._offsets[part_index::num_parts]
+        self._rec = MXRecordIO(path_imgrec, "r")
+        self._order = np.arange(len(self._offsets))
+        self.reset()
+
+    @property
+    def provide_data(self):
+        from .io import DataDesc
+
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        from .io import DataDesc
+
+        return [DataDesc(
+            self.label_name, (self.batch_size, self.max_objs, self.obj_width)
+        )]
+
+    def reset(self):
+        if self.shuffle:
+            self.rs.shuffle(self._order)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def _load_one(self, offset, seed):
+        import cv2
+
+        with self._lock:
+            self._rec.handle.seek(offset)
+            buf = self._rec.read()
+        header, img_buf = unpack(buf)
+        img = cv2.imdecode(np.frombuffer(img_buf, np.uint8), cv2.IMREAD_COLOR)
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        boxes = _parse_det_label(header.label)
+        # per-record RandomState: the pool workers run concurrently, and a
+        # shared RandomState is both thread-unsafe and schedule-dependent —
+        # per-item seeds drawn sequentially keep augmentation reproducible
+        img, boxes = self.aug(img, boxes, rng=np.random.RandomState(seed))
+        arr = (img.astype(np.float32) - self.mean) / self.std * self.scale
+        arr = arr.transpose(2, 0, 1)
+        padded = np.full((self.max_objs, self.obj_width), _PAD, np.float32)
+        n = min(len(boxes), self.max_objs)
+        if n:
+            padded[:n] = boxes[:n]
+        return arr, padded
+
+    def _fetch(self):
+        from .io import DataBatch
+        from .ndarray import array
+
+        n = len(self._order)
+        if self._cursor + self.batch_size > n:
+            raise StopIteration
+        idxs = self._order[self._cursor:self._cursor + self.batch_size]
+        self._cursor += self.batch_size
+        seeds = self.rs.randint(0, 2 ** 31 - 1, size=len(idxs))
+        results = list(
+            self._pool.map(
+                lambda args: self._load_one(self._offsets[args[0]], args[1]),
+                zip(idxs, seeds),
+            )
+        )
+        data = np.stack([r[0] for r in results])
+        label = np.stack([r[1] for r in results])
+        return DataBatch(
+            data=[array(data)], label=[array(label)], pad=0, index=None,
+            provide_data=self.provide_data, provide_label=self.provide_label,
+        )
+
+    # --- DataIter protocol (iter_next advances; getdata reads current) ----
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        return self._cur
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        try:
+            self._cur = self._fetch()
+            return True
+        except StopIteration:
+            self._cur = None
+            return False
+
+    def getdata(self):
+        return self._cur.data
+
+    def getlabel(self):
+        return self._cur.label
+
+    def getpad(self):
+        return self._cur.pad if self._cur else 0
+
+    def getindex(self):
+        return None

@@ -459,6 +459,14 @@ def ImageRecordIter(**kwargs):
     return _Impl(**kwargs)
 
 
+def ImageDetRecordIter(**kwargs):
+    """Detection-aware RecordIO iterator (reference
+    iter_image_det_recordio.cc:563); see mxnet_tpu.image_det."""
+    from .image_det import ImageDetRecordIter as _Impl
+
+    return _Impl(**kwargs)
+
+
 class LibSVMIter(DataIter):
     """Sparse libsvm-format reader producing CSR data batches (reference
     ``src/io/iter_libsvm.cc:170`` + sparse batch loader

@@ -114,35 +114,40 @@ class KVStore:
 
         assert out is not None and row_ids is not None
         keys, outs = _key_value(key, out)
-        if len(keys) == 1 and isinstance(outs[0], (list, tuple)):
-            # single key, per-device out list: row_ids pairs with out
-            # entry-by-entry (reference PullRowSparse ships one row-id set
-            # per destination, kvstore_dist.h:274-350)
-            targets = list(outs[0])
+        # per-key row_ids: a bare NDArray is shared by all keys; a list pairs
+        # key-by-key, except the single-key case where it pairs with the
+        # per-device out list (reference PullRowSparse ships one row-id set
+        # per destination, kvstore_dist.h:274-350)
+        if not isinstance(row_ids, (list, tuple)):
+            key_rids = [row_ids] * len(keys)
+        elif len(keys) == 1 and isinstance(outs[0], (list, tuple)):
+            key_rids = [list(row_ids)]
+        else:
+            if len(row_ids) != len(keys):
+                raise MXNetError(
+                    f"row_sparse_pull: {len(keys)} keys but "
+                    f"{len(row_ids)} row_ids"
+                )
+            key_rids = list(row_ids)
+        for k, o, rid_k in zip(keys, outs, key_rids):
+            src = self._store[k]
+            targets = list(o) if isinstance(o, (list, tuple)) else [o]
             rids = (
-                list(row_ids) if isinstance(row_ids, (list, tuple))
-                else [row_ids] * len(targets)
+                list(rid_k) if isinstance(rid_k, (list, tuple))
+                else [rid_k] * len(targets)
             )
             if len(rids) != len(targets):
                 raise MXNetError(
-                    f"row_sparse_pull: {len(targets)} outs but "
+                    f"row_sparse_pull: key {k}: {len(targets)} outs but "
                     f"{len(rids)} row_ids"
                 )
-            pairs = [(keys[0], t, r) for t, r in zip(targets, rids)]
-        else:
-            rids = (
-                list(row_ids) if isinstance(row_ids, (list, tuple))
-                else [row_ids] * len(keys)
-            )
-            pairs = list(zip(keys, outs, rids))
-        for k, t, rid in pairs:
-            src = self._store[k]
-            rows = np.unique(np.asarray(rid.asnumpy(), np.int32))
-            if not isinstance(t, RowSparseNDArray):
-                raise MXNetError("row_sparse_pull needs row_sparse outs")
-            t._values = src._data[rows]
-            t._aux = [_asjax(rows, np.int32)]
-            t._d = None
+            for t, rid in zip(targets, rids):
+                if not isinstance(t, RowSparseNDArray):
+                    raise MXNetError("row_sparse_pull needs row_sparse outs")
+                rows = np.unique(np.asarray(rid.asnumpy(), np.int32))
+                t._values = src._data[rows]
+                t._aux = [_asjax(rows, np.int32)]
+                t._d = None
 
     # --- optimizer plane ----------------------------------------------
     def set_optimizer(self, optimizer):
@@ -197,15 +202,16 @@ class DistKVStore(KVStore):
         import jax
 
         self._jax = jax
-        # rendezvous: tools/launch.py sets MXNET_COORDINATOR/NUM_PROCS/PROC_ID
-        # (the analogue of ps-lite's DMLC_* env rendezvous, MXInitPSEnv)
-        coord = os.environ.get("MXNET_COORDINATOR")
+        # rendezvous happens at package import (MXNET_COORDINATOR env from
+        # tools/launch.py → _maybe_init_distributed, the analogue of
+        # ps-lite's DMLC_* env rendezvous / MXInitPSEnv); by the time a
+        # kvstore is created the multi-host runtime is already up
         nproc = int(os.environ.get("MXNET_NUM_PROCS", "1"))
-        if coord and nproc > 1 and jax.process_count() == 1:
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=nproc,
-                process_id=int(os.environ["MXNET_PROC_ID"]),
+        if nproc > 1 and jax.process_count() != nproc:
+            raise MXNetError(
+                f"dist kvstore: jax runtime has {jax.process_count()} "
+                f"processes but MXNET_NUM_PROCS={nproc}; import mxnet_tpu "
+                "before any other jax use in launched workers"
             )
         if "async" in kv_type:
             import logging
@@ -224,16 +230,114 @@ class DistKVStore(KVStore):
     def num_workers(self):
         return self._jax.process_count()
 
+    # --- cross-process data plane --------------------------------------
+    def _leader_mesh(self):
+        """1-D mesh over one device per process — the reduction topology.
+
+        The reference reduces per-key on parameter servers over ZMQ
+        (kvstore_dist.h Push_/ZPush); here the reduction is one XLA
+        collective over ICI/DCN: each process contributes its locally
+        merged value as a shard of a global array, a jitted sum over the
+        process axis all-reduces it, and every host reads back the
+        replicated result.
+        """
+        if getattr(self, "_mesh", None) is None:
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            leaders = []
+            seen = set()
+            for d in self._jax.devices():
+                if d.process_index not in seen:
+                    seen.add(d.process_index)
+                    leaders.append(d)
+            self._mesh = Mesh(leaders, ("p",))
+            # one jitted reducer per mesh — a fresh lambda per push would
+            # miss the pjit fastpath and retrace every step
+            self._reducer = jax.jit(
+                lambda a: a.sum(0),
+                out_shardings=NamedSharding(self._mesh, P()),
+            )
+        return self._mesh
+
+    def _allreduce(self, value):
+        """Sum an NDArray's value across all processes; returns jax array."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self.num_workers == 1:
+            return value._data
+        mesh = self._leader_mesh()
+        my_leader = next(
+            d for d in mesh.devices.flat if d.process_index == self.rank
+        )
+        local = jnp.asarray(value._data)[None]
+        local = jax.device_put(local, my_leader)
+        garr = jax.make_array_from_single_device_arrays(
+            (self.num_workers,) + tuple(value.shape),
+            NamedSharding(mesh, P("p")),
+            [local],
+        )
+        return self._reducer(garr).addressable_data(0)
+
+    def init(self, key, value):
+        """Rank 0's value wins (reference: init runs once on the servers)."""
+        from .sparse_ndarray import BaseSparseNDArray
+
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError(f"key {k} already initialized")
+            vv = v[0] if isinstance(v, (list, tuple)) else v
+            if isinstance(vv, BaseSparseNDArray):
+                vv = vv.todense()
+            if self.num_workers > 1:
+                contrib = vv if self.rank == 0 else zeros(vv.shape, dtype=vv.dtype)
+                self._store[k] = NDArray(self._allreduce(contrib))
+            else:
+                self._store[k] = vv.copy()
+
+    def push(self, key, value, priority=0):
+        """Local merge, then one all-reduce per key across processes, then
+        the updater — bulk-synchronous like the reference's sync mode
+        (kvstore_dist_server.h DataHandleDefault waits for all workers)."""
+        from .sparse_ndarray import BaseSparseNDArray, elemwise_add
+
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if isinstance(v, (list, tuple)):
+                if any(isinstance(x, BaseSparseNDArray) for x in v):
+                    merged = v[0]
+                    for x in v[1:]:
+                        merged = elemwise_add(merged, x)
+                else:
+                    merged = v[0].copy()
+                    for x in v[1:]:
+                        merged += x
+            else:
+                merged = v.copy() if not isinstance(v, BaseSparseNDArray) else v
+            if isinstance(merged, BaseSparseNDArray):
+                merged = merged.todense()  # dense wire format across hosts
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            if self.num_workers > 1:
+                merged = NDArray(self._allreduce(merged))
+            if self._updater is not None:
+                self._updater(_updater_key(k), merged, self._store[k])
+            else:
+                self._store[k] = merged
+
     def barrier(self):
-        # A tiny all-reduce across all devices synchronises hosts.
+        # an all-reduce of a scalar synchronises all hosts; must BLOCK —
+        # jax dispatch is async and a barrier that only enqueues is a race
         import jax
         import jax.numpy as jnp
 
-        if jax.process_count() > 1:
-            x = jnp.ones((jax.local_device_count(),))
-            jax.block_until_ready(
-                jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x)
-            )
+        if self.num_workers > 1:
+            from .ndarray import NDArray as _ND
+
+            jax.block_until_ready(self._allreduce(_ND(jnp.ones((1,)))))
 
 
 def create(name="local"):

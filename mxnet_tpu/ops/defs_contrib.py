@@ -402,3 +402,147 @@ register(
     },
     aliases=("_contrib_count_sketch",),
 )
+
+
+# --- Proposal (RPN, reference src/operator/contrib/proposal-inl.h) ----------
+def _generate_anchors(base_size, ratios, scales):
+    """py-faster-rcnn anchor enumeration with the reference's rounding
+    (proposal-inl.h utils::GenerateAnchors): ratios first, then scales."""
+    base = np.array([0, 0, base_size - 1, base_size - 1], np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    x_ctr = base[0] + 0.5 * (w - 1)
+    y_ctr = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        size_ratio = size / r
+        ws = round(math.sqrt(size_ratio))
+        hs = round(ws * r)
+        for s in scales:
+            sw, sh = ws * s, hs * s
+            anchors.append([
+                x_ctr - 0.5 * (sw - 1), y_ctr - 0.5 * (sh - 1),
+                x_ctr + 0.5 * (sw - 1), y_ctr + 0.5 * (sh - 1),
+            ])
+    return np.array(anchors, np.float32)
+
+
+def _proposal(ins, params, mode):
+    """RPN proposal layer: anchors + deltas → clip → min-size filter →
+    pre-NMS top-k → greedy NMS → post-NMS top-k. One fused XLA program —
+    the sort/IOU-matrix NMS replaces the reference's CUDA workspace kernels.
+    """
+    cls_prob, bbox_pred, im_info = ins
+    B, twoA, H, W = cls_prob.shape
+    if B != 1:
+        raise MXNetError("Proposal: only batch size 1 supported (reference parity)")
+    A = twoA // 2
+    stride = params["feature_stride"]
+    anchors = jnp.asarray(
+        _generate_anchors(stride, params["ratios"], params["scales"])
+    )  # (A, 4)
+    # all shifted anchors, row-major over (H, W, A) like the reference
+    shift_x = jnp.arange(W, dtype=jnp.float32) * stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 1, 4)  # (H*W,1,4)
+    all_anchors = (anchors[None] + shifts).reshape(-1, 4)  # (H*W*A, 4)
+
+    scores = cls_prob[0, A:].transpose(1, 2, 0).reshape(-1)  # fg scores (H*W*A)
+    deltas = bbox_pred[0].transpose(1, 2, 0).reshape(-1, 4)
+
+    # BBoxTransformInv (proposal-inl.h): deltas → proposals
+    ws = all_anchors[:, 2] - all_anchors[:, 0] + 1.0
+    hs = all_anchors[:, 3] - all_anchors[:, 1] + 1.0
+    ctr_x = all_anchors[:, 0] + 0.5 * (ws - 1.0)
+    ctr_y = all_anchors[:, 1] + 0.5 * (hs - 1.0)
+    if params["iou_loss"]:
+        x1 = all_anchors[:, 0] + deltas[:, 0]
+        y1 = all_anchors[:, 1] + deltas[:, 1]
+        x2 = all_anchors[:, 2] + deltas[:, 2]
+        y2 = all_anchors[:, 3] + deltas[:, 3]
+    else:
+        pred_ctr_x = deltas[:, 0] * ws + ctr_x
+        pred_ctr_y = deltas[:, 1] * hs + ctr_y
+        pred_w = jnp.exp(deltas[:, 2]) * ws
+        pred_h = jnp.exp(deltas[:, 3]) * hs
+        x1 = pred_ctr_x - 0.5 * (pred_w - 1.0)
+        y1 = pred_ctr_y - 0.5 * (pred_h - 1.0)
+        x2 = pred_ctr_x + 0.5 * (pred_w - 1.0)
+        y2 = pred_ctr_y + 0.5 * (pred_h - 1.0)
+    im_h, im_w = im_info[0, 0], im_info[0, 1]
+    x1 = jnp.clip(x1, 0, im_w - 1.0)
+    y1 = jnp.clip(y1, 0, im_h - 1.0)
+    x2 = jnp.clip(x2, 0, im_w - 1.0)
+    y2 = jnp.clip(y2, 0, im_h - 1.0)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+
+    # min-size filter scaled by im_info scale (FilterBox)
+    min_size = params["rpn_min_size"] * im_info[0, 2]
+    keep_size = ((x2 - x1 + 1.0) >= min_size) & ((y2 - y1 + 1.0) >= min_size)
+    scores = jnp.where(keep_size, scores, -jnp.inf)
+
+    pre_nms = min(params["rpn_pre_nms_top_n"], boxes.shape[0])
+    post_nms = params["rpn_post_nms_top_n"]
+    top_scores, top_idx = jax.lax.top_k(scores, pre_nms)
+    top_boxes = boxes[top_idx]
+
+    # greedy NMS over score-sorted boxes (reference NonMaximumSuppression)
+    iou = _iou_matrix_corner_pixel(top_boxes)
+    sup = iou >= params["threshold"]
+    tri = jnp.tril(jnp.ones((pre_nms, pre_nms), bool), k=-1)
+    valid = top_scores > -jnp.inf
+
+    def body(i, keep):
+        suppressed = jnp.any(sup[i] & tri[i] & keep)
+        return keep.at[i].set(keep[i] & ~suppressed)
+
+    keep = jax.lax.fori_loop(0, pre_nms, body, valid)
+    # kept boxes first (stable), pad by repeating the top proposal like the
+    # reference pads its fixed-size output workspace
+    order = jnp.argsort(~keep, stable=True)
+    sel = order[:post_nms]
+    n_keep = jnp.sum(keep)
+    sel = jnp.where(jnp.arange(post_nms) < n_keep, sel, sel[0])
+    out_boxes = top_boxes[sel]
+    out_scores = top_scores[sel].reshape(-1, 1)
+    rois = jnp.concatenate(
+        [jnp.zeros((post_nms, 1), boxes.dtype), out_boxes], axis=1
+    )
+    return [rois, out_scores]
+
+
+def _iou_matrix_corner_pixel(boxes):
+    """Pairwise IOU with the +1 pixel convention the RPN uses."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = (x2 - x1 + 1.0) * (y2 - y1 + 1.0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    iw = jnp.maximum(ix2 - ix1 + 1.0, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + 1.0, 0.0)
+    inter = iw * ih
+    return inter / (area[:, None] + area[None, :] - inter)
+
+
+register(
+    "Proposal",
+    _proposal,
+    arg_names=["cls_prob", "bbox_pred", "im_info"],
+    param_schema={
+        "rpn_pre_nms_top_n": Param(parse_int, 6000),
+        "rpn_post_nms_top_n": Param(parse_int, 300),
+        "threshold": Param(parse_float, 0.7),
+        "rpn_min_size": Param(parse_int, 16),
+        "scales": Param(_parse_floats, (4.0, 8.0, 16.0, 32.0)),
+        "ratios": Param(_parse_floats, (0.5, 1.0, 2.0)),
+        "feature_stride": Param(parse_int, 16),
+        "output_score": Param(parse_bool, False),
+        "iou_loss": Param(parse_bool, False),
+    },
+    num_outputs=2,
+    num_visible_outputs=lambda p: 2 if p["output_score"] else 1,
+    aliases=("_contrib_Proposal", "proposal"),
+)

@@ -329,7 +329,7 @@ class ImageRecordIter:
 
     _lock = threading.Lock()
 
-    def next(self):
+    def _fetch(self):
         from .io import DataBatch
         from .ndarray import array
 
@@ -351,12 +351,31 @@ class ImageRecordIter:
             provide_data=self.provide_data, provide_label=self.provide_label,
         )
 
+    # --- DataIter protocol (iter_next advances; getdata reads current) ----
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        return self._cur
+
     def __next__(self):
         return self.next()
 
     def iter_next(self):
         try:
-            self._peeked = self.next()
+            self._cur = self._fetch()
             return True
         except StopIteration:
+            self._cur = None
             return False
+
+    def getdata(self):
+        return self._cur.data
+
+    def getlabel(self):
+        return self._cur.label
+
+    def getpad(self):
+        return self._cur.pad if self._cur else 0
+
+    def getindex(self):
+        return None

@@ -1,0 +1,90 @@
+"""Worker script for the multi-process dist kvstore test.
+
+Launched by tools/launch.py --launcher local (the reference's nightly
+pattern, ``tests/nightly/dist_sync_kvstore.py:22-58``): every rank runs this
+same script; asserts exact reduction arithmetic across ranks.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    rank = kv.rank
+    nw = kv.num_workers
+    assert nw == int(os.environ["DMLC_NUM_WORKER"]), (nw, os.environ["DMLC_NUM_WORKER"])
+
+    # --- dense reduction: push ones*(rank+1), expect sum_r (r+1) ---------
+    shape = (3, 4)
+    kv.init("dense", mx.nd.zeros(shape))
+    kv.push("dense", mx.nd.ones(shape) * (rank + 1))
+    out = mx.nd.zeros(shape)
+    kv.pull("dense", out=out)
+    expect = sum(r + 1 for r in range(nw))
+    assert np.allclose(out.asnumpy(), expect), (rank, out.asnumpy()[0, 0], expect)
+
+    # --- repeated rounds stay exact --------------------------------------
+    for step in range(3):
+        kv.push("dense", mx.nd.ones(shape) * (rank + 1 + step))
+        kv.pull("dense", out=out)
+        expect = sum(r + 1 + step for r in range(nw))
+        assert np.allclose(out.asnumpy(), expect), (rank, step)
+
+    # --- init broadcast: non-zero only on rank 0 --------------------------
+    init_val = mx.nd.ones((4,)) * 7 if rank == 0 else mx.nd.zeros((4,))
+    kv.init("bcast", init_val)
+    got = mx.nd.zeros((4,))
+    kv.pull("bcast", out=got)
+    assert np.allclose(got.asnumpy(), 7), (rank, got.asnumpy())
+
+    # --- multi-key + per-worker device list push --------------------------
+    kv.init(["a", "b"], [mx.nd.zeros((2,)), mx.nd.zeros((2,))])
+    kv.push(
+        ["a", "b"],
+        [[mx.nd.ones((2,)) * rank, mx.nd.ones((2,)) * rank],  # 2 "devices"
+         [mx.nd.ones((2,))]],
+    )
+    oa, ob = mx.nd.zeros((2,)), mx.nd.zeros((2,))
+    kv.pull(["a", "b"], out=[oa, ob])
+    assert np.allclose(oa.asnumpy(), 2 * sum(range(nw))), oa.asnumpy()
+    assert np.allclose(ob.asnumpy(), nw), ob.asnumpy()
+
+    # --- row_sparse push densifies and reduces exactly --------------------
+    from mxnet_tpu import sparse_ndarray as sp
+
+    kv.init("rsp", mx.nd.zeros((6, 2)))
+    g = sp.row_sparse(np.ones((1, 2), np.float32) * (rank + 1), [rank], (6, 2))
+    kv.push("rsp", g)
+    orsp = mx.nd.zeros((6, 2))
+    kv.pull("rsp", out=orsp)
+    dense = np.zeros((6, 2), np.float32)
+    for r in range(nw):
+        dense[r] = r + 1
+    assert np.allclose(orsp.asnumpy(), dense), (rank, orsp.asnumpy())
+
+    # --- updater applied identically on every rank ------------------------
+    opt = mx.optimizer.create("sgd", learning_rate=0.5)
+    kv.set_optimizer(opt)
+    kv.init("w", mx.nd.ones((2, 2)))
+    kv.push("w", mx.nd.ones((2, 2)))  # summed grad = nw
+    wout = mx.nd.zeros((2, 2))
+    kv.pull("w", out=wout)
+    # sgd: w - lr * grad_sum = 1 - 0.5*nw
+    assert np.allclose(wout.asnumpy(), 1 - 0.5 * nw), (rank, wout.asnumpy())
+
+    kv.barrier()
+    print(f"rank {rank}/{nw} DIST OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

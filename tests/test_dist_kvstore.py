@@ -1,0 +1,47 @@
+"""Process-level distributed kvstore test.
+
+Spawns real local processes through tools/launch.py --launcher local (the
+reference's nightly tracker pattern) running tests/dist_worker.py, which
+asserts exact reduction arithmetic across ranks — the port of
+``tests/nightly/dist_sync_kvstore.py:22-58``.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.parametrize("nproc", [2, 3])
+def test_dist_sync_kvstore_local_processes(nproc):
+    env = dict(os.environ)
+    # workers must initialise their own jax runtime on CPU, not inherit the
+    # test process's virtual-device settings
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+        "-n", str(nproc), "--launcher", "local",
+        "--port", str(_free_port()),
+        sys.executable, os.path.join(_ROOT, "tests", "dist_worker.py"),
+    ]
+    proc = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=300
+    )
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"dist job failed:\n{out[-4000:]}"
+    for r in range(nproc):
+        assert f"rank {r}/{nproc} DIST OK" in out, out[-4000:]
