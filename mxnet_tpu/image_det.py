@@ -304,6 +304,8 @@ class ImageDetRecordIter:
             provide_data=self.provide_data, provide_label=self.provide_label,
         )
 
+    _cur = None
+
     # --- DataIter protocol (iter_next advances; getdata reads current) ----
     def next(self):
         if not self.iter_next():
@@ -321,14 +323,19 @@ class ImageDetRecordIter:
             self._cur = None
             return False
 
+    def _current(self):
+        if self._cur is None:
+            raise MXNetError("no current batch: call iter_next() first")
+        return self._cur
+
     def getdata(self):
-        return self._cur.data
+        return self._current().data
 
     def getlabel(self):
-        return self._cur.label
+        return self._current().label
 
     def getpad(self):
-        return self._cur.pad if self._cur else 0
+        return self._cur.pad if self._cur is not None else 0
 
     def getindex(self):
         return None

@@ -34,6 +34,27 @@ def _key_str(key):
     return str(key)
 
 
+def _merge_pushed(v):
+    """Merge one pushed value (single NDArray or per-device list) into one
+    array. A replicated/sharded run's values are already identical
+    post-psum; a genuine per-device list is tree-summed like
+    CommDevice::Reduce (row_sparse lists merge by row union, reference
+    CommCPU sparse reduce comm.h:183-362)."""
+    from .sparse_ndarray import BaseSparseNDArray, elemwise_add
+
+    if isinstance(v, (list, tuple)):
+        if any(isinstance(x, BaseSparseNDArray) for x in v):
+            merged = v[0]
+            for x in v[1:]:
+                merged = elemwise_add(merged, x)
+            return merged
+        merged = v[0].copy()
+        for x in v[1:]:
+            merged += x
+        return merged
+    return v.copy() if not isinstance(v, BaseSparseNDArray) else v
+
+
 class KVStore:
     """In-process key-value store (covers local + device modes)."""
 
@@ -66,25 +87,11 @@ class KVStore:
             self._store[k] = vv.copy()
 
     def push(self, key, value, priority=0):
-        from .sparse_ndarray import BaseSparseNDArray, elemwise_add
+        from .sparse_ndarray import BaseSparseNDArray
 
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
-            if isinstance(v, (list, tuple)):
-                # multi-device push: values from a replicated/sharded run are
-                # already identical post-psum; a genuine per-device list is
-                # tree-summed like CommDevice::Reduce (row_sparse lists merge
-                # by row union, reference CommCPU sparse reduce comm.h:183-362).
-                if any(isinstance(x, BaseSparseNDArray) for x in v):
-                    merged = v[0]
-                    for x in v[1:]:
-                        merged = elemwise_add(merged, x)
-                else:
-                    merged = v[0].copy()
-                    for x in v[1:]:
-                        merged += x
-            else:
-                merged = v.copy() if not isinstance(v, BaseSparseNDArray) else v
+            merged = _merge_pushed(v)
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
             if self._updater is not None:
@@ -302,21 +309,11 @@ class DistKVStore(KVStore):
         """Local merge, then one all-reduce per key across processes, then
         the updater — bulk-synchronous like the reference's sync mode
         (kvstore_dist_server.h DataHandleDefault waits for all workers)."""
-        from .sparse_ndarray import BaseSparseNDArray, elemwise_add
+        from .sparse_ndarray import BaseSparseNDArray
 
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
-            if isinstance(v, (list, tuple)):
-                if any(isinstance(x, BaseSparseNDArray) for x in v):
-                    merged = v[0]
-                    for x in v[1:]:
-                        merged = elemwise_add(merged, x)
-                else:
-                    merged = v[0].copy()
-                    for x in v[1:]:
-                        merged += x
-            else:
-                merged = v.copy() if not isinstance(v, BaseSparseNDArray) else v
+            merged = _merge_pushed(v)
             if isinstance(merged, BaseSparseNDArray):
                 merged = merged.todense()  # dense wire format across hosts
             if k not in self._store:

@@ -616,78 +616,212 @@ def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3, mea
 
 
 # ---------------------------------------------------------------------------
-# save / load (reference: src/ndarray/ndarray.cc:806+, binary magic format)
+# save / load — REFERENCE-BINARY-COMPATIBLE .params format
+# (src/ndarray/ndarray.cc:806+ NDArray::Save V2, container :1004-1030;
+# container magic kMXAPINDArrayListMagic=0x112 :1002; legacy V1/V0 load paths
+# :871-918 so reference-era checkpoints and model-zoo files load directly)
 # ---------------------------------------------------------------------------
-_SAVE_MAGIC = b"MXTPU001"
+_LIST_MAGIC = 0x112
+_ND_V2_MAGIC = 0xF993FAC9
+_ND_V1_MAGIC = 0xF993FAC8
+_OLD_CUSTOM_MAGIC = b"MXTPU001"  # round-1 container, still readable
+
+# mshadow type flags (mshadow/base.h); 100+ are our extensions for dtypes
+# the CUDA-era reference cannot represent
+_TYPE_FLAG_TO_NP = {
+    0: "float32", 1: "float64", 2: "float16", 3: "uint8", 4: "int32",
+    5: "int8", 6: "int64", 100: "bfloat16",
+}
+_NP_TO_TYPE_FLAG = {v: k for k, v in _TYPE_FLAG_TO_NP.items()}
+_STYPE_TO_ID = {"default": 0, "row_sparse": 1, "csr": 2}
+_ID_TO_STYPE = {v: k for k, v in _STYPE_TO_ID.items()}
+
+
+def _np_of(arr):
+    np_arr = arr if isinstance(arr, np.ndarray) else np.asarray(arr)
+    return np.ascontiguousarray(np_arr)
+
+
+def _write_shape(f, shape):
+    # nnvm::Tuple::Save: uint32 ndim + uint32 dims
+    f.write(struct.pack("<I", len(shape)))
+    f.write(struct.pack(f"<{len(shape)}I", *shape))
+
+
+def _read_shape(f):
+    (ndim,) = struct.unpack("<I", f.read(4))
+    return struct.unpack(f"<{ndim}I", f.read(4 * ndim)) if ndim else ()
+
+
+def _dtype_np(buf, dtype_name, shape):
+    if dtype_name == "bfloat16":
+        import ml_dtypes
+
+        return np.frombuffer(buf, dtype=ml_dtypes.bfloat16).reshape(shape)
+    return np.frombuffer(buf, dtype=dtype_name).reshape(shape)
+
+
+def _save_one(f, arr):
+    """One NDArray in the reference V2 layout (ndarray.cc:806-870)."""
+    from .sparse_ndarray import BaseSparseNDArray
+
+    stype = arr.stype
+    f.write(struct.pack("<I", _ND_V2_MAGIC))
+    f.write(struct.pack("<i", _STYPE_TO_ID[stype]))
+    if isinstance(arr, BaseSparseNDArray):
+        values = _np_of(arr._values)
+        # aux written as int64 — the reference's aux dtype — so its loader
+        # accepts our sparse checkpoints (we use int32 on device); _aux is
+        # already in the reference's order ([kIndPtr, kIdx] for csr,
+        # ndarray.h:62)
+        aux = [_np_of(a).astype(np.int64) for a in arr._aux]
+        _write_shape(f, values.shape)  # storage shape
+    else:
+        values = _np_of(arr.asnumpy())
+        aux = []
+    if values.ndim == 0:
+        # reference TShape has no rank-0; scalars serialize as (1,)
+        values = values.reshape(1)
+    _write_shape(f, values.shape if not aux else arr.shape)
+    f.write(struct.pack("<ii", 1, 0))  # Context: kCPU, dev_id 0
+    dtype_name = np.dtype(values.dtype).name
+    if dtype_name not in _NP_TO_TYPE_FLAG:  # unknown dtypes fall back
+        values = values.astype(np.float32)
+        dtype_name = "float32"
+    f.write(struct.pack("<i", _NP_TO_TYPE_FLAG[dtype_name]))
+    for a in aux:
+        f.write(struct.pack("<i", _NP_TO_TYPE_FLAG["int64"]))
+        _write_shape(f, a.shape)
+    f.write(values.tobytes())
+    for a in aux:
+        f.write(a.tobytes())
+
+
+def _load_one(f):
+    from . import sparse_ndarray as _sp
+
+    (magic,) = struct.unpack("<I", f.read(4))
+    if magic == _ND_V2_MAGIC:
+        (stype_id,) = struct.unpack("<i", f.read(4))
+        stype = _ID_TO_STYPE[stype_id]
+        nad = {"default": 0, "row_sparse": 1, "csr": 2}[stype]
+        storage_shape = _read_shape(f) if nad else None
+        shape = _read_shape(f)
+        if not shape:
+            return array(np.zeros((0,), np.float32))
+        f.read(8)  # Context (ignored: arrays land on the default device)
+        (type_flag,) = struct.unpack("<i", f.read(4))
+        dtype_name = _TYPE_FLAG_TO_NP[type_flag]
+        aux_meta = []
+        for _ in range(nad):
+            (aux_flag,) = struct.unpack("<i", f.read(4))
+            aux_meta.append((_TYPE_FLAG_TO_NP[aux_flag], _read_shape(f)))
+        data_shape = storage_shape if nad else shape
+        nbytes = int(np.prod(data_shape, dtype=np.int64)) * np.dtype(
+            "uint16" if dtype_name == "bfloat16" else dtype_name
+        ).itemsize
+        values = _dtype_np(f.read(nbytes), dtype_name, data_shape)
+        auxes = []
+        for dt, sh in aux_meta:
+            n = int(np.prod(sh, dtype=np.int64)) * np.dtype(dt).itemsize
+            auxes.append(np.frombuffer(f.read(n), dtype=dt).reshape(sh))
+        if stype == "row_sparse":
+            return _sp.row_sparse(values, auxes[0].astype(np.int32), shape)
+        if stype == "csr":
+            return _sp.csr(values, auxes[0].astype(np.int32),
+                           auxes[1].astype(np.int32), shape)
+        return array(values, dtype=values.dtype)
+    # legacy V1 / V0 dense layouts (ndarray.cc LegacyLoad :888-918)
+    if magic == _ND_V1_MAGIC:
+        shape = _read_shape(f)
+    else:
+        ndim = magic  # V0: the magic word IS ndim
+        shape = struct.unpack(f"<{ndim}I", f.read(4 * ndim)) if ndim else ()
+    if not shape:
+        return array(np.zeros((0,), np.float32))
+    f.read(8)  # Context
+    (type_flag,) = struct.unpack("<i", f.read(4))
+    dtype_name = _TYPE_FLAG_TO_NP[type_flag]
+    nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype_name).itemsize
+    values = _dtype_np(f.read(nbytes), dtype_name, shape)
+    return array(values, dtype=values.dtype)
 
 
 def save(fname, data):
-    """Save NDArrays. Accepts one array, a list, or a dict (like reference).
-
-    Format: custom container — magic, count, then per-entry name + numpy
-    buffer. Readable only by this framework (the reference's binary layout is
-    CUDA-era and not reproduced byte-for-byte), but API-compatible.
-    """
+    """Save NDArrays in the reference's binary .params container — files are
+    interchangeable with the reference's ``mx.nd.save`` (ndarray.cc:1004)."""
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
         items = list(data.items())
+        names = [k for k, _ in items]
     elif isinstance(data, (list, tuple)):
         items = [("", d) for d in data]
+        names = []
     else:
         raise MXNetError("save: data must be NDArray, list or dict")
+    for _, arr in items:
+        if not isinstance(arr, NDArray):
+            raise MXNetError("save: values must be NDArray")
     with open(fname, "wb") as f:
-        f.write(_SAVE_MAGIC)
-        f.write(struct.pack("<q", len(items)))
-        for name, arr in items:
-            if not isinstance(arr, NDArray):
-                raise MXNetError("save: values must be NDArray")
-            nb = name.encode()
-            f.write(struct.pack("<q", len(nb)))
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(items)))
+        for _, arr in items:
+            _save_one(f, arr)
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            nb = n.encode()
+            f.write(struct.pack("<Q", len(nb)))
             f.write(nb)
-            np_arr = arr.asnumpy()
-            header = (
-                f"{np_arr.dtype.name}|{','.join(map(str, np_arr.shape))}"
-                f"|{arr.stype}".encode()
-            )
-            f.write(struct.pack("<q", len(header)))
-            f.write(header)
-            buf = np.ascontiguousarray(np_arr).tobytes()
-            f.write(struct.pack("<q", len(buf)))
-            f.write(buf)
 
 
 def load(fname):
-    """Load NDArrays saved by :func:`save`. Returns list or dict."""
+    """Load a .params file (reference container, legacy V1/V0 arrays, or the
+    round-1 custom container). Returns list or dict."""
     with open(fname, "rb") as f:
-        magic = f.read(len(_SAVE_MAGIC))
-        if magic != _SAVE_MAGIC:
+        head = f.read(8)
+        if head == _OLD_CUSTOM_MAGIC:
+            return _load_old_custom(f)
+        (header,) = struct.unpack("<Q", head)
+        (reserved,) = struct.unpack("<Q", f.read(8))
+        if header != _LIST_MAGIC:
             raise MXNetError(f"{fname}: not a valid NDArray file")
-        (count,) = struct.unpack("<q", f.read(8))
-        names, arrays = [], []
-        for _ in range(count):
-            (nlen,) = struct.unpack("<q", f.read(8))
-            name = f.read(nlen).decode()
-            (hlen,) = struct.unpack("<q", f.read(8))
-            parts = f.read(hlen).decode().split("|")
-            dtype_s, shape_s = parts[0], parts[1]
-            stype = parts[2] if len(parts) > 2 else "default"
-            shape = tuple(int(x) for x in shape_s.split(",")) if shape_s else ()
-            (blen,) = struct.unpack("<q", f.read(8))
-            buf = f.read(blen)
-            if dtype_s == "bfloat16":
-                import ml_dtypes
+        (count,) = struct.unpack("<Q", f.read(8))
+        arrays = [_load_one(f) for _ in range(count)]
+        (ncount,) = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(ncount):
+            (nlen,) = struct.unpack("<Q", f.read(8))
+            names.append(f.read(nlen).decode())
+    if names:
+        if len(names) != len(arrays):
+            raise MXNetError(f"{fname}: name/array count mismatch")
+        return dict(zip(names, arrays))
+    return arrays
 
-                arr = np.frombuffer(buf, dtype=ml_dtypes.bfloat16).reshape(shape)
-            else:
-                arr = np.frombuffer(buf, dtype=dtype_s).reshape(shape)
-            names.append(name)
-            out_arr = array(arr, dtype=arr.dtype)
-            if stype != "default":
-                from .sparse_ndarray import cast_storage as _cast
 
-                out_arr = _cast(out_arr, stype)
-            arrays.append(out_arr)
+def _load_old_custom(f):
+    """Round-1 container (magic MXTPU001), kept readable."""
+    (count,) = struct.unpack("<q", f.read(8))
+    names, arrays = [], []
+    for _ in range(count):
+        (nlen,) = struct.unpack("<q", f.read(8))
+        name = f.read(nlen).decode()
+        (hlen,) = struct.unpack("<q", f.read(8))
+        parts = f.read(hlen).decode().split("|")
+        dtype_s, shape_s = parts[0], parts[1]
+        stype = parts[2] if len(parts) > 2 else "default"
+        shape = tuple(int(x) for x in shape_s.split(",")) if shape_s else ()
+        (blen,) = struct.unpack("<q", f.read(8))
+        buf = f.read(blen)
+        arr = _dtype_np(buf, dtype_s, shape)
+        out_arr = array(arr, dtype=arr.dtype)
+        if stype != "default":
+            from .sparse_ndarray import cast_storage as _cast
+
+            out_arr = _cast(out_arr, stype)
+        names.append(name)
+        arrays.append(out_arr)
     if any(names):
         return dict(zip(names, arrays))
     return arrays

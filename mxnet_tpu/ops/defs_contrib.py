@@ -500,11 +500,17 @@ def _proposal(ins, params, mode):
 
     keep = jax.lax.fori_loop(0, pre_nms, body, valid)
     # kept boxes first (stable), pad by repeating the top proposal like the
-    # reference pads its fixed-size output workspace
+    # reference pads its fixed-size output workspace; small feature maps can
+    # have fewer than post_nms candidates
     order = jnp.argsort(~keep, stable=True)
-    sel = order[:post_nms]
+    take = min(post_nms, pre_nms)
+    sel = order[:take]
     n_keep = jnp.sum(keep)
-    sel = jnp.where(jnp.arange(post_nms) < n_keep, sel, sel[0])
+    sel = jnp.where(jnp.arange(take) < n_keep, sel, sel[0])
+    if take < post_nms:
+        sel = jnp.concatenate(
+            [sel, jnp.broadcast_to(sel[:1], (post_nms - take,))]
+        )
     out_boxes = top_boxes[sel]
     out_scores = top_scores[sel].reshape(-1, 1)
     rois = jnp.concatenate(
