@@ -107,13 +107,16 @@ def main():
                       f"dtype: {dtype} image/sec: {speed:.2f}")
     if args.json:
         (net, bs), speed = max(results.items(), key=lambda kv: kv[1])
-        baseline = 713.17  # reference P100 resnet-50 score @bs32
-        print(json.dumps({
+        record = {
             "metric": f"{net}_score_throughput_bs{bs}",
             "value": round(speed, 2),
             "unit": "images/sec",
-            "vs_baseline": round(speed / baseline, 3),
-        }))
+        }
+        if net == "resnet-50" and bs == 32:
+            # the published baseline is resnet-50 @ bs32 only
+            # (P100, docs/how_to/perf.md:138-147)
+            record["vs_baseline"] = round(speed / 713.17, 3)
+        print(json.dumps(record))
 
 
 if __name__ == "__main__":

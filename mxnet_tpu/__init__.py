@@ -15,10 +15,11 @@ def _maybe_init_distributed():
     """
     import os
 
-    coord = os.environ.get("MXNET_COORDINATOR")
-    nproc = int(os.environ.get("MXNET_NUM_PROCS", "1"))
-    proc_id = os.environ.get("MXNET_PROC_ID")
-    if coord and nproc > 1 and proc_id is not None:
+    from . import env  # stdlib-only; safe before jax
+
+    coord = env.get("MXNET_COORDINATOR")
+    nproc = env.get("MXNET_NUM_PROCS")
+    if coord and nproc > 1 and "MXNET_PROC_ID" in os.environ:
         import jax
 
         try:
@@ -27,7 +28,7 @@ def _maybe_init_distributed():
             jax.distributed.initialize(
                 coordinator_address=coord,
                 num_processes=nproc,
-                process_id=int(proc_id),
+                process_id=env.get("MXNET_PROC_ID"),
             )
         except RuntimeError:
             # the worker script (or another framework) already initialised
@@ -39,6 +40,7 @@ def _maybe_init_distributed():
 _maybe_init_distributed()
 
 from .base import MXNetError, __version__
+from . import env  # noqa: F401 (also imported inside _maybe_init_distributed)
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
 
 from . import ndarray

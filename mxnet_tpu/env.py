@@ -1,0 +1,90 @@
+"""Runtime environment-variable catalogue.
+
+Reference: ``docs/how_to/env_var.md`` + scattered ``dmlc::GetEnv`` reads.
+Here every honored variable is declared once with type, default and
+documentation; modules read through :func:`get` so the catalogue can never
+drift from the implementation. ``mx.env.document()`` renders the table
+(the env_var.md analogue) and unknown ``MXNET_*`` variables can be audited
+with :func:`check_unknown`.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import namedtuple
+
+_Var = namedtuple("_Var", ["name", "parse", "default", "doc"])
+
+_CATALOGUE = {}
+
+
+def _declare(name, parse, default, doc):
+    _CATALOGUE[name] = _Var(name, parse, default, doc)
+
+
+def _parse_bool(v):
+    return str(v).lower() not in ("0", "false", "")
+
+
+_declare("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+         "Execution engine. 'NaiveEngine' runs every executor in the "
+         "synchronous un-jitted interpret mode for debugging (reference "
+         "src/engine/engine.cc:14-27); anything else uses the default "
+         "lazy + jitted XLA path (the ThreadedEnginePerDevice analogue).")
+_declare("MXNET_EXEC_BULK_EXEC_TRAIN", _parse_bool, True,
+         "When false, disables the fused fwd+bwd+update single-program "
+         "train step; the per-parameter imperative update path runs "
+         "instead (reference MXNET_EXEC_BULK_EXEC_TRAIN).")
+_declare("MXNET_PROFILER_AUTOSTART", _parse_bool, False,
+         "Start the profiler at import (reference env_var.md:69-78).")
+_declare("MXNET_PROFILER_MODE", str, "symbolic",
+         "Profiler mode ('symbolic' or 'all'); recorded in the trace "
+         "metadata (XLA traces always cover all device ops).")
+_declare("MXNET_COORDINATOR", str, "",
+         "host:port of process 0 for multi-host jobs; set by "
+         "tools/launch.py (the DMLC_PS_ROOT_URI analogue). Triggers "
+         "jax.distributed.initialize at import.")
+_declare("MXNET_NUM_PROCS", int, 1,
+         "Total processes in the multi-host job (DMLC_NUM_WORKER).")
+_declare("MXNET_PROC_ID", int, 0,
+         "This process's rank (DMLC_WORKER_ID).")
+_declare("MXNET_CPU_WORKER_NTHREADS", int, 4,
+         "Host-side worker threads for the decode/augment data plane "
+         "(reference MXNET_CPU_WORKER_NTHREADS; default thread-pool size "
+         "of ImageRecordIter/ImageDetRecordIter).")
+_declare("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+         "Accepted for reference parity. Reduction here is one XLA "
+         "collective regardless of array size, so no server sharding "
+         "threshold applies.")
+_declare("MXNET_BACKWARD_DO_MIRROR", _parse_bool, False,
+         "When true, executors run backward with jax.checkpoint-style "
+         "rematerialisation to trade compute for activation memory "
+         "(reference mirror option, graph_executor.cc:222-280).")
+
+
+def get(name):
+    """Typed value of a declared variable (env override else default)."""
+    var = _CATALOGUE[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return var.default
+    try:
+        return var.parse(raw)
+    except (TypeError, ValueError):
+        return var.default
+
+
+def document():
+    """The catalogue as a markdown table (docs/how_to/env_var.md analogue)."""
+    lines = ["| Variable | Default | Description |", "|---|---|---|"]
+    for var in _CATALOGUE.values():
+        lines.append(f"| {var.name} | {var.default!r} | {var.doc} |")
+    return "\n".join(lines)
+
+
+def check_unknown():
+    """MXNET_* variables set in the environment but not in the catalogue."""
+    return sorted(
+        k for k in os.environ
+        if k.startswith("MXNET_") and k not in _CATALOGUE
+    )

@@ -38,6 +38,14 @@ from .ops.registry import OpMode
 
 _GRAD_REQ = ("write", "add", "null")
 
+# ops whose FGradient drives backward without an explicit head gradient
+# (reference loss layers: their backward ignores out_grad)
+_LOSS_OPS = {
+    "SoftmaxOutput", "MakeLoss", "LinearRegressionOutput",
+    "LogisticRegressionOutput", "MAERegressionOutput", "SVMOutput",
+    "make_loss", "Softmax",
+}
+
 
 def _fold_rng(rng):
     """Fold a (base_key, step) pair into a per-step PRNG key, inside jit."""
@@ -56,9 +64,13 @@ class _CompiledGraph:
     nodes inserted by the PlaceDevice pass (graph_executor.cc:286-385).
     """
 
-    def __init__(self, symbol, node2dev=None):
+    def __init__(self, symbol, node2dev=None, remat=False):
         self.symbol = symbol
         self.node2dev = node2dev or {}
+        # remat (reference MXNET_BACKWARD_DO_MIRROR): wrap each op in
+        # jax.checkpoint so backward recomputes op-internal values from op
+        # inputs instead of storing them — FLOPs for activation memory
+        self.remat = remat
         self.topo = symbol._topo()
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -99,9 +111,17 @@ class _CompiledGraph:
             node_rng = None
             if node.op.need_rng:
                 node_rng = jax.random.fold_in(rng, self._rng_serial[id(node)])
-            outs, new_aux = node.op.apply(
-                ins, params, OpMode(is_train=is_train, rng=node_rng)
-            )
+            if self.remat and not node.op.aux_names(params):
+                apply_fn = jax.checkpoint(
+                    lambda inner, _op=node.op, _p=params, _m=OpMode(
+                        is_train=is_train, rng=node_rng
+                    ): _op.apply(inner, _p, _m)
+                )
+                outs, new_aux = apply_fn(ins)
+            else:
+                outs, new_aux = node.op.apply(
+                    ins, params, OpMode(is_train=is_train, rng=node_rng)
+                )
             env[id(node)] = outs
             if new_aux:
                 n_args = len(node.op.arg_names(params))
@@ -122,10 +142,18 @@ class Executor:
     def __init__(self, symbol, ctx, args=None, args_grad=None, grad_req="write",
                  aux_states=None, group2ctx=None, shared_exec=None,
                  in_shardings=None):
+        from . import env as _env
+
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
         self._node2dev = self._place_nodes(symbol, group2ctx)
-        self.graph = _CompiledGraph(symbol, node2dev=self._node2dev)
+        # NaiveEngine: synchronous un-jitted execution for debugging
+        # (reference sync-debug engine toggle, src/engine/engine.cc:14-27)
+        self._naive = _env.get("MXNET_ENGINE_TYPE") == "NaiveEngine"
+        self.graph = _CompiledGraph(
+            symbol, node2dev=self._node2dev,
+            remat=_env.get("MXNET_BACKWARD_DO_MIRROR"),
+        )
         self.arg_names = self.graph.arg_names
         self.aux_names = self.graph.aux_names
         self.output_names = symbol.list_outputs()
@@ -167,7 +195,12 @@ class Executor:
         self._step = 0
         import jax
 
-        self._base_key = jax.random.PRNGKey(0)
+        # executor rng chain derives from the GLOBAL seed at bind time, so
+        # mx.random.seed() controls symbolic Dropout/rrelu (reference:
+        # per-device Resource kRandom seeded from the global seed)
+        from . import random as _random
+
+        self._base_key = _random.next_key()
         self._jit_cache = {}
         self._fused_plan = {}  # (names, token, hg, treedef) -> (fn, idxs)
         if shared_exec is not None:
@@ -301,14 +334,14 @@ class Executor:
                 )
                 return outs, aux_upd
 
-            fn = _fwd if self._node2dev else jax.jit(_fwd)
+            fn = _fwd if (self._node2dev or self._naive) else jax.jit(_fwd)
         elif kind == "train_step":
             core = self._make_grad_core()
             # ctx-group placement spans devices: XLA compiles single-device
             # (or SPMD-sharded) programs only, so a placed graph executes
             # eagerly — per-op dispatch on the op's device, like the
             # reference engine's per-device worker queues
-            fn = core if self._node2dev else jax.jit(core)
+            fn = core if (self._node2dev or self._naive) else jax.jit(core)
         else:
             raise MXNetError(f"unknown jit kind {kind}")
         self._jit_cache[cache_key] = fn
@@ -325,6 +358,19 @@ class Executor:
         wrt_idx = [graph._arg_index[n] for n in self._wrt_names]
         wrt_names = tuple(self._wrt_names)
         add_names = [n for n in self._wrt_names if self.grad_req[n] == "add"]
+        # backward() without out_grads: loss-layer heads drive the backward
+        # (their custom_vjp ignores the head grad, so ones is a formality);
+        # non-loss heads contribute ZERO — the reference executor doesn't
+        # inject gradients for extra outputs like Group(loss, features)
+        head_is_loss = [
+            not node.is_variable and node.op.name in _LOSS_OPS
+            for (node, _ix) in graph.heads
+        ]
+        if not any(head_is_loss):
+            # no loss head at all: an out_grads-less backward would be all
+            # zeros; surface the misuse instead (reference executor errors
+            # when a required head gradient is missing)
+            head_is_loss = None
 
         def core(arg_vals, aux_vals, rng, head_grads, prev_grads):
             key = _fold_rng(rng)
@@ -338,11 +384,18 @@ class Executor:
                 for j, o in enumerate(outs):
                     if not jnp.issubdtype(o.dtype, jnp.floating):
                         continue
-                    hg = (
-                        head_grads[j]
-                        if head_grads is not None
-                        else jnp.ones_like(o)
-                    )
+                    if head_grads is not None:
+                        hg = head_grads[j]
+                    elif head_is_loss is None:
+                        raise MXNetError(
+                            "backward() without out_grads requires a loss "
+                            "output (SoftmaxOutput/MakeLoss/...); pass "
+                            "explicit head gradients for plain outputs"
+                        )
+                    elif head_is_loss[j]:
+                        hg = jnp.ones_like(o)
+                    else:
+                        continue  # no implicit gradient for non-loss heads
                     t = jnp.sum(o.astype(jnp.float32) * hg.astype(jnp.float32))
                     total = t if total is None else total + t
                 if total is None:
@@ -393,8 +446,8 @@ class Executor:
         self._args_in = self._arg_vals()
         self._aux_in = self._aux_vals()
         self._fwd_rng = self._rng_key()
-        if self._monitor_callback is not None:
-            self._materialize_forward()
+        if self._monitor_callback is not None or self._naive:
+            self._materialize_forward()  # NaiveEngine: synchronous dispatch
         else:
             for h in self._output_handles:
                 h._set_lazy(self._materialize_forward)

@@ -196,7 +196,7 @@ class ImageDetRecordIter:
     def __init__(self, path_imgrec, data_shape, batch_size, label_pad_width=0,
                  shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
-                 part_index=0, num_parts=1, preprocess_threads=4, seed=0,
+                 part_index=0, num_parts=1, preprocess_threads=None, seed=0,
                  data_name="data", label_name="label", **aug_kwargs):
         import cv2  # noqa: F401 — fail early if decode backend missing
 
@@ -210,6 +210,10 @@ class ImageDetRecordIter:
         self.shuffle = shuffle
         self.rs = np.random.RandomState(seed)
         self.aug = DetAugmenter(data_shape, rng=self.rs, **aug_kwargs)
+        from . import env as _env
+
+        if preprocess_threads is None:
+            preprocess_threads = _env.get("MXNET_CPU_WORKER_NTHREADS")
         self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
         self._lock = threading.Lock()
 

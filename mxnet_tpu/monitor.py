@@ -1,81 +1,102 @@
 """Monitor — per-tensor statistics each batch.
 
-Reference: ``python/mxnet/monitor.py:16+`` installing
-``MXExecutorSetMonitorCallback``; the executor calls back with every op
-output (which forces the un-fused interpret mode, see
-``graph_executor.cc:1252`` where bulk exec disables itself under a monitor).
+Reference behaviour (``python/mxnet/monitor.py``, executor hook
+``graph_executor.cc:1327-1347``): installing a monitor forces the
+executor's un-fused interpret mode (bulk exec disables itself,
+``graph_executor.cc:1252``) and the callback sees every op output; ``toc``
+additionally stats the executor's argument arrays.
+
+Re-designed here as a small recording pipeline: the executor callback and
+the parameter sweep both feed one ``_Record`` stream; statistics are
+computed eagerly on host (the arrays arrive as NDArray handles whose
+fetch is the synchronisation point — no engine wait calls needed, jax's
+data dependency ordering guarantees the values are post-forward).
 """
 
 from __future__ import annotations
 
 import logging
 import re
-from math import sqrt
+from collections import namedtuple
+
+import numpy as np
 
 from .ndarray import NDArray
 
+_Record = namedtuple("_Record", ["step", "name", "value"])
+
+
+def _mean_abs(x):
+    """Default statistic: mean |x| (reference asum_stat)."""
+    a = np.abs(x.asnumpy() if isinstance(x, NDArray) else np.asarray(x))
+    return float(a.sum() / a.size)
+
 
 class Monitor:
+    """Collects a statistic of selected tensors every ``interval`` batches.
+
+    Parameters mirror the reference: ``stat_func`` maps an NDArray to a
+    stat (any printable / NDArray / list result), ``pattern`` filters
+    tensor names, ``sort`` orders the report by name.
+    """
+
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                return x.abs().sum() / x.size
+        self.interval = int(interval)
+        self.stat_func = stat_func or _mean_abs
+        self._name_filter = re.compile(pattern)
+        self._sort = sort
+        self._records = []
+        self._armed = False
+        self._batch = 0
+        self._executors = []
 
-            stat_func = asum_stat
-        self.stat_func = stat_func
-        self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
-        self.re_prog = re.compile(pattern)
-        self.sort = sort
-
-        def stat_helper(name, arr):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(arr)))
-
-        self.stat_helper = stat_helper
-
+    # -- executor integration -------------------------------------------
     def install(self, exe):
-        exe.set_monitor_callback(self.stat_helper)
-        self.exes.append(exe)
+        """Hook an executor; its per-op outputs flow to this monitor."""
+        exe.set_monitor_callback(self._on_tensor)
+        self._executors.append(exe)
 
+    def _on_tensor(self, name, arr):
+        if self._armed and self._name_filter.match(name):
+            self._records.append(_Record(self._batch, name, self.stat_func(arr)))
+
+    # -- batch protocol ---------------------------------------------------
     def tic(self):
-        if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
-            self.queue = []
-            self.activated = True
-        self.step += 1
+        """Arm collection if this batch is on the interval."""
+        if self._batch % self.interval == 0:
+            self._records = []
+            self._armed = True
+        self._batch += 1
 
     def toc(self):
-        if not self.activated:
+        """Disarm and return [(batch, name, stat_string)] for the batch."""
+        if not self._armed:
             return []
-        for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
-        for exe in self.exes:
-            for name, array in zip(exe.arg_names, exe.arg_arrays):
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
-        self.activated = False
-        res = []
-        if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ",".join(str(v.asnumpy() if isinstance(v, NDArray) else v)
-                         for v in v_list)
-            res.append((n, k, s))
-        self.queue = []
-        return res
+        for exe in self._executors:
+            for name, arr in zip(exe.arg_names, exe.arg_arrays):
+                if self._name_filter.match(name):
+                    self._records.append(
+                        _Record(self._batch, name, self.stat_func(arr))
+                    )
+        self._armed = False
+        out = self._records
+        self._records = []
+        if self._sort:
+            out = sorted(out, key=lambda r: r.name)
+        return [(r.step, r.name, _render(r.value)) for r in out]
 
     def toc_print(self):
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        """Log the collected stats (reference toc_print format)."""
+        for step, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, stat)
+
+
+def _render(value):
+    if isinstance(value, NDArray):
+        value = [value]
+    if isinstance(value, (list, tuple)):
+        return ",".join(
+            str(v.asnumpy()) if isinstance(v, NDArray) else str(v)
+            for v in value
+        )
+    return str(value)

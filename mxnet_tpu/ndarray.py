@@ -779,20 +779,32 @@ def load(fname):
     """Load a .params file (reference container, legacy V1/V0 arrays, or the
     round-1 custom container). Returns list or dict."""
     with open(fname, "rb") as f:
-        head = f.read(8)
-        if head == _OLD_CUSTOM_MAGIC:
-            return _load_old_custom(f)
-        (header,) = struct.unpack("<Q", head)
-        (reserved,) = struct.unpack("<Q", f.read(8))
-        if header != _LIST_MAGIC:
-            raise MXNetError(f"{fname}: not a valid NDArray file")
-        (count,) = struct.unpack("<Q", f.read(8))
-        arrays = [_load_one(f) for _ in range(count)]
-        (ncount,) = struct.unpack("<Q", f.read(8))
-        names = []
-        for _ in range(ncount):
-            (nlen,) = struct.unpack("<Q", f.read(8))
-            names.append(f.read(nlen).decode())
+        return _load_stream(f, fname)
+
+
+def load_buffer(data):
+    """Load NDArrays from an in-memory .params blob (reference
+    ``MXNDArrayLoadFromBytes`` / the c_predict_api param-bytes input)."""
+    import io
+
+    return _load_stream(io.BytesIO(data), "<buffer>")
+
+
+def _load_stream(f, fname):
+    head = f.read(8)
+    if head == _OLD_CUSTOM_MAGIC:
+        return _load_old_custom(f)
+    (header,) = struct.unpack("<Q", head)
+    (reserved,) = struct.unpack("<Q", f.read(8))
+    if header != _LIST_MAGIC:
+        raise MXNetError(f"{fname}: not a valid NDArray file")
+    (count,) = struct.unpack("<Q", f.read(8))
+    arrays = [_load_one(f) for _ in range(count)]
+    (ncount,) = struct.unpack("<Q", f.read(8))
+    names = []
+    for _ in range(ncount):
+        (nlen,) = struct.unpack("<Q", f.read(8))
+        names.append(f.read(nlen).decode())
     if names:
         if len(names) != len(arrays):
             raise MXNetError(f"{fname}: name/array count mismatch")

@@ -61,11 +61,16 @@ def _fc(ins, params, mode):
     else:
         data, weight, bias = ins
     weight, bias = _castp(weight, data), _castp(bias, data)
-    x = data.reshape((data.shape[0], -1))
+    if params["flatten"]:
+        x = data.reshape((data.shape[0], -1))
+    else:
+        # flatten=False: FC applies to the LAST axis, leading dims kept
+        # (reference fully_connected-inl.h Flatten=false path)
+        x = data
     out = jax.lax.dot_general(
         x,
         weight,
-        (((1,), (1,)), ((), ())),
+        (((x.ndim - 1,), (1,)), ((), ())),
         precision=_prec(x.dtype),
     )
     if bias is not None:
@@ -77,7 +82,9 @@ def _fc_fill(shapes, params):
     data, *rest = shapes
     n = params["num_hidden"]
     if data is not None:
-        in_dim = int(np.prod(data[1:]))
+        in_dim = (
+            int(np.prod(data[1:])) if params["flatten"] else int(data[-1])
+        )
         if shapes[1] is None:
             shapes[1] = (n, in_dim)
     if not params["no_bias"] and shapes[2] is None:

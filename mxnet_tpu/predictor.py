@@ -37,7 +37,11 @@ class Predictor:
             ctx = Context(dev_type, dev_id)
         self.ctx = ctx
 
-        if isinstance(param_source, (str, bytes)):
+        if isinstance(param_source, bytes):
+            from .ndarray import load_buffer
+
+            params = load_buffer(param_source)  # MXPredCreate param blob
+        elif isinstance(param_source, str):
             params = nd_load(param_source)
         else:
             params = param_source
@@ -70,7 +74,9 @@ class Predictor:
                     )
                 args[name] = self.arg_params[name]
             else:
-                raise MXNetError(f"missing parameter {name!r}")
+                # reference c_predict_api leaves args absent from the param
+                # file zero-initialised (labels etc., c_predict_api.cc:195)
+                args[name] = zeros(shape, ctx=self.ctx)
         auxs = {}
         for name, shape in zip(aux_names, aux_shapes):
             if name in self.aux_params:

@@ -1,16 +1,22 @@
 """Profiler — execution tracing.
 
 Reference: ``python/mxnet/profiler.py:10-38`` + the in-engine profiler
-(``src/engine/profiler.{h,cc}``) dumping Chrome trace-event JSON. TPU
-mapping (SURVEY.md §5): delegate to the jax/XLA profiler, which captures
-device traces (op-level, HBM, MXU utilisation) viewable in
-TensorBoard/Perfetto — strictly more detail than the reference's per-op
-timestamps; the reference python API shape is preserved.
+(``src/engine/profiler.{h,cc}``) dumping Chrome trace-event JSON at the
+configured filename. TPU mapping (SURVEY.md §5): the jax/XLA profiler
+captures the device trace (op-level, HBM, MXU utilisation);
+``dump_profile`` honours the reference's file contract by extracting the
+chrome-trace JSON out of the captured run and writing it to
+``filename`` — loadable in chrome://tracing / Perfetto exactly like the
+reference's output. ``MXNET_PROFILER_AUTOSTART`` starts tracing at import
+(reference env_var.md:69-78).
 """
 
 from __future__ import annotations
 
+import glob
+import gzip
 import os
+import shutil
 
 _state = {"mode": "symbolic", "filename": "profile.json", "running": False}
 
@@ -36,10 +42,27 @@ def profiler_set_state(state="stop"):
 
 
 def dump_profile():
-    """Stop tracing and report where the trace landed."""
+    """Write the chrome-trace JSON to the configured filename.
+
+    Returns the filename (reference contract: the file the user set via
+    profiler_set_config exists and holds trace-event JSON after this
+    call). The raw xplane/TensorBoard artifacts stay in the side logdir
+    for deeper analysis.
+    """
     if _state["running"]:
         profiler_set_state("stop")
-    return _state.get("logdir")
+    logdir = _state.get("logdir")
+    if not logdir:
+        return None
+    fname = _state["filename"]
+    traces = sorted(glob.glob(
+        os.path.join(logdir, "**", "*.trace.json.gz"), recursive=True
+    ))
+    if traces:
+        with gzip.open(traces[-1], "rb") as src, open(fname, "wb") as dst:
+            shutil.copyfileobj(src, dst)
+        return fname
+    return None
 
 
 class trace_annotation:
@@ -56,3 +79,14 @@ class trace_annotation:
 
     def __exit__(self, *a):
         return self._ann.__exit__(*a)
+
+
+def _maybe_autostart():
+    from . import env as _env
+
+    if _env.get("MXNET_PROFILER_AUTOSTART"):
+        profiler_set_config(mode=_env.get("MXNET_PROFILER_MODE"))
+        profiler_set_state("run")
+
+
+_maybe_autostart()

@@ -233,7 +233,7 @@ class ImageRecordIter:
                  resize=-1, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
                  max_random_scale=1.0, min_random_scale=1.0,
-                 part_index=0, num_parts=1, preprocess_threads=4,
+                 part_index=0, num_parts=1, preprocess_threads=None,
                  round_batch=True, seed=0, data_name="data",
                  label_name="softmax_label", path_imgidx=None, **kwargs):
         import cv2  # noqa: F401 — fail early if decode backend missing
@@ -252,6 +252,10 @@ class ImageRecordIter:
         self.data_name = data_name
         self.label_name = label_name
         self.rs = np.random.RandomState(seed)
+        from . import env as _env
+
+        if preprocess_threads is None:
+            preprocess_threads = _env.get("MXNET_CPU_WORKER_NTHREADS")
         self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
 
         # index all record offsets once (sequential scan)
@@ -292,9 +296,13 @@ class ImageRecordIter:
     def __iter__(self):
         return self
 
-    def _load_one(self, offset):
+    def _load_one(self, offset, seed):
         import cv2
 
+        # per-record RandomState: pool workers run concurrently; a shared
+        # RandomState is thread-unsafe and schedule-dependent, so per-item
+        # seeds drawn sequentially keep augmentation reproducible
+        rs = np.random.RandomState(seed)
         self._lock.acquire()
         try:
             self._rec.handle.seek(offset)
@@ -311,15 +319,16 @@ class ImageRecordIter:
             img = cv2.resize(img, (int(round(img.shape[1] * s)), int(round(img.shape[0] * s))))
         ih, iw = img.shape[:2]
         if self.rand_crop and (ih > h or iw > w):
-            y = self.rs.randint(0, ih - h + 1)
-            x = self.rs.randint(0, iw - w + 1)
+            # per-axis bounds: one dimension may already be <= target
+            y = rs.randint(0, max(ih - h, 0) + 1)
+            x = rs.randint(0, max(iw - w, 0) + 1)
         else:
             y = max((ih - h) // 2, 0)
             x = max((iw - w) // 2, 0)
         if ih < h or iw < w:
             img = cv2.resize(img, (max(w, iw), max(h, ih)))
         img = img[y:y + h, x:x + w]
-        if self.rand_mirror and self.rs.rand() < 0.5:
+        if self.rand_mirror and rs.rand() < 0.5:
             img = img[:, ::-1]
         arr = img.astype(np.float32)
         arr = (arr - self.mean) / self.std * self.scale
@@ -338,8 +347,12 @@ class ImageRecordIter:
             raise StopIteration
         idxs = self._order[self._cursor:self._cursor + self.batch_size]
         self._cursor += self.batch_size
+        seeds = self.rs.randint(0, 2 ** 31 - 1, size=len(idxs))
         results = list(
-            self._pool.map(lambda i: self._load_one(self._offsets[i]), idxs)
+            self._pool.map(
+                lambda args: self._load_one(self._offsets[args[0]], args[1]),
+                zip(idxs, seeds),
+            )
         )
         data = np.stack([r[0] for r in results])
         if self.label_width == 1:

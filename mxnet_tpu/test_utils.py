@@ -331,15 +331,20 @@ def check_speed(sym, location=None, ctx=None, N=20, grad_req=None,
     for name, arr in location.items():
         exe.arg_dict[name][:] = arr
 
+    def ones_heads():
+        # arbitrary symbols need explicit head grads (backward() with no
+        # out_grads is reserved for loss-layer heads)
+        return [NDArray(jax.numpy.ones_like(o._data)) for o in exe.outputs]
+
     if typ == "whole":
         exe.forward(is_train=True)
-        exe.backward()
+        exe.backward(ones_heads())
         for o in exe.outputs:
             o.wait_to_read()
         tic = time.time()
         for _ in range(N):
             exe.forward(is_train=True)
-            exe.backward()
+            exe.backward(ones_heads())
         for o in exe.outputs:
             o.wait_to_read()
         jax.effects_barrier()

@@ -1,0 +1,305 @@
+"""Aux subsystems: env catalogue, NaiveEngine, profiler contract, monitor,
+predictor, FeedForward, visualization, remat — the previously untested
+surface (VERDICT weak item 8 + env/profiler items).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+# --------------------------------------------------------------------------
+# env catalogue
+# --------------------------------------------------------------------------
+def test_env_catalogue_document_and_get():
+    doc = mx.env.document()
+    assert "MXNET_ENGINE_TYPE" in doc and "| Default |" in doc
+    assert mx.env.get("MXNET_NUM_PROCS") >= 1
+    os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"] = "123"
+    try:
+        assert mx.env.get("MXNET_KVSTORE_BIGARRAY_BOUND") == 123
+    finally:
+        del os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"]
+
+
+def test_env_check_unknown():
+    os.environ["MXNET_NOT_A_REAL_VAR"] = "1"
+    try:
+        assert "MXNET_NOT_A_REAL_VAR" in mx.env.check_unknown()
+    finally:
+        del os.environ["MXNET_NOT_A_REAL_VAR"]
+
+
+# --------------------------------------------------------------------------
+# NaiveEngine sync-debug toggle (reference engine.cc:14-27)
+# --------------------------------------------------------------------------
+def test_naive_engine_matches_default():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6).astype(np.float32)
+
+    def run():
+        mx.random.seed(11)
+        sym = _mlp()
+        exe = sym.simple_bind(mx.cpu(), data=(4, 6), softmax_label=(4,))
+        mx.random.seed(12)
+        ini = mx.init.Xavier()
+        for n, a in exe.arg_dict.items():
+            if n not in ("data", "softmax_label"):
+                ini(mx.init.InitDesc(n), a)
+        exe.arg_dict["data"][:] = mx.nd.array(x)
+        exe.arg_dict["softmax_label"][:] = mx.nd.array(np.zeros(4, np.float32))
+        exe.forward(is_train=True)
+        out = exe.outputs[0].asnumpy()
+        exe.backward()
+        return out, exe.grad_dict["fc1_weight"].asnumpy()
+
+    base_out, base_grad = run()
+    os.environ["MXNET_ENGINE_TYPE"] = "NaiveEngine"
+    try:
+        naive_out, naive_grad = run()
+    finally:
+        del os.environ["MXNET_ENGINE_TYPE"]
+    assert_almost_equal(base_out, naive_out, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(base_grad, naive_grad, rtol=1e-5, atol=1e-5)
+
+
+def test_bulk_exec_toggle_trains_identically():
+    rng = np.random.RandomState(1)
+    X = rng.randn(16, 6).astype(np.float32)
+    Y = (rng.rand(16) * 3).astype(np.float32)
+
+    def train():
+        mx.random.seed(5)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.bind(data_shapes=[("data", (8, 6))],
+                 label_shapes=[("softmax_label", (8,))])
+        mod.init_params(initializer=mx.init.Xavier(), force_init=True)
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1},
+                           force_init=True)
+        it = mx.io.NDArrayIter(X, Y, batch_size=8)
+        for _ in range(3):
+            it.reset()
+            for b in it:
+                mod.forward_backward(b)
+                mod.update()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    fused = train()
+    os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"] = "0"
+    try:
+        unfused = train()
+    finally:
+        del os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"]
+    for k in fused:
+        assert_almost_equal(fused[k], unfused[k], rtol=1e-4, atol=1e-5,
+                            names=(f"fused:{k}", f"unfused:{k}"))
+
+
+# --------------------------------------------------------------------------
+# executor rng honours the global seed (ADVICE item)
+# --------------------------------------------------------------------------
+def test_symbolic_dropout_respects_global_seed():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Dropout(data, p=0.5)
+    x = np.ones((16, 16), np.float32)
+
+    def mask(seed_v):
+        mx.random.seed(seed_v)
+        exe = sym.bind(mx.cpu(), args={"data": mx.nd.array(x)})
+        exe.forward(is_train=True)
+        return exe.outputs[0].asnumpy()
+
+    a, b = mask(1), mask(1)
+    c = mask(2)
+    assert_almost_equal(a, b)
+    assert np.abs(a - c).max() > 0, "different seeds gave identical dropout"
+
+
+# --------------------------------------------------------------------------
+# backward without out_grads (ADVICE item)
+# --------------------------------------------------------------------------
+def test_backward_requires_loss_or_out_grads():
+    data = mx.sym.Variable("data")
+    sym = data * 2.0  # no loss head
+    exe = sym.bind(mx.cpu(), args={"data": mx.nd.ones((2, 2))},
+                   args_grad={"data": mx.nd.zeros((2, 2))})
+    exe.forward(is_train=True)
+    exe.backward()
+    with pytest.raises(mx.MXNetError, match="loss"):
+        exe.grad_dict["data"].asnumpy()  # materialisation surfaces the error
+
+
+def test_backward_group_ignores_non_loss_heads():
+    """Group(loss, features): implicit backward must not inject gradients
+    from the feature head (ADVICE executor.py:262)."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    feat = data * 3.0
+    loss = mx.sym.LinearRegressionOutput(feat, label, name="lro")
+    group = mx.sym.Group([loss, feat])
+    x = np.array([[1.0, 2.0]], np.float32)
+    y = np.array([[0.0, 0.0]], np.float32)
+    exe = group.bind(
+        mx.cpu(),
+        args={"data": mx.nd.array(x), "label": mx.nd.array(y)},
+        args_grad={"data": mx.nd.zeros((1, 2))},
+        grad_req={"data": "write", "label": "null"},
+    )
+    exe.forward(is_train=True)
+    exe.backward()
+    # d(loss)/d(data) only: (pred-label)/num_output * d(feat)/d(data)
+    expect = (3 * x - y) / x.shape[1] * 3.0
+    assert_almost_equal(exe.grad_dict["data"].asnumpy(), expect, rtol=1e-4)
+
+
+# --------------------------------------------------------------------------
+# FC flatten=False (ADVICE item)
+# --------------------------------------------------------------------------
+def test_fc_flatten_false():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(data, num_hidden=5, flatten=False, name="fc",
+                                no_bias=True)
+    x = np.random.RandomState(0).randn(2, 3, 4).astype(np.float32)
+    w = np.random.RandomState(1).randn(5, 4).astype(np.float32)
+    exe = sym.bind(mx.cpu(), args={"data": mx.nd.array(x),
+                                   "fc_weight": mx.nd.array(w)})
+    out = exe.forward()[0].asnumpy()
+    assert out.shape == (2, 3, 5)
+    assert_almost_equal(out, x.dot(w.T), rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# profiler file contract
+# --------------------------------------------------------------------------
+def test_profiler_dump_writes_chrome_trace(tmp_path):
+    fname = str(tmp_path / "prof.json")
+    mx.profiler.profiler_set_config(mode="all", filename=fname)
+    mx.profiler.profiler_set_state("run")
+    (mx.nd.ones((64, 64)) * 2).wait_to_read()
+    mx.profiler.profiler_set_state("stop")
+    out = mx.profiler.dump_profile()
+    assert out == fname and os.path.exists(fname)
+    with open(fname) as f:
+        trace = json.load(f)
+    assert "traceEvents" in trace and len(trace["traceEvents"]) > 0
+
+
+# --------------------------------------------------------------------------
+# monitor
+# --------------------------------------------------------------------------
+def test_monitor_collects_stats():
+    mon = mx.monitor.Monitor(interval=1, pattern=".*fc1.*")
+    sym = _mlp()
+    exe = sym.simple_bind(mx.cpu(), data=(2, 6), softmax_label=(2,))
+    for n, a in exe.arg_dict.items():
+        if n not in ("data", "softmax_label"):
+            a[:] = mx.nd.ones(a.shape) * 0.1
+    mon.install(exe)
+    mon.tic()
+    exe.arg_dict["data"][:] = mx.nd.ones((2, 6))
+    exe.forward(is_train=True)
+    rows = mon.toc()
+    names = [r[1] for r in rows]
+    assert any("fc1_output" in n for n in names)
+    assert any(n == "fc1_weight" for n in names)  # param sweep in toc
+    assert all(isinstance(r[2], str) for r in rows)
+
+
+# --------------------------------------------------------------------------
+# predictor + FeedForward + visualization
+# --------------------------------------------------------------------------
+def test_predictor_api(tmp_path):
+    sym = _mlp()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 6))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "pred")
+    mod.save_checkpoint(prefix, 0)
+    with open(prefix + "-symbol.json") as f:
+        symbol_json = f.read()
+    with open(prefix + "-0000.params", "rb") as f:
+        param_bytes = f.read()
+    pred = mx.predictor.Predictor(
+        symbol_json, param_bytes, {"data": (2, 6)}
+    )
+    x = np.random.RandomState(0).rand(2, 6).astype(np.float32)
+    pred.forward(data=x)
+    out = pred.get_output(0)
+    mod.forward(mx.io.DataBatch([mx.nd.array(x)], []), is_train=False)
+    assert_almost_equal(out, mod.get_outputs()[0].asnumpy(), rtol=1e-5)
+
+
+def test_feedforward_fit_predict():
+    rng = np.random.RandomState(3)
+    X = rng.randn(32, 6).astype(np.float32)
+    W = rng.randn(6, 3).astype(np.float32)
+    Y = X.dot(W).argmax(1).astype(np.float32)
+    model = mx.model.FeedForward(
+        symbol=_mlp(), ctx=mx.cpu(), num_epoch=6,
+        optimizer="sgd", learning_rate=0.3,
+        initializer=mx.init.Xavier(),
+    )
+    model.fit(X=mx.io.NDArrayIter(X, Y, batch_size=8))
+    prob = model.predict(mx.io.NDArrayIter(X, batch_size=8))
+    acc = (prob.argmax(1) == Y).mean()
+    assert acc > 0.8, f"FeedForward did not learn: {acc}"
+
+
+def test_visualization_summary_and_plot():
+    sym = _mlp()
+    txt = mx.viz.print_summary(sym, shape={"data": (1, 6)})
+    assert txt is None or isinstance(txt, str)  # prints; must not raise
+    try:
+        g = mx.viz.plot_network(sym, shape={"data": (1, 6)})
+        assert g is not None
+    except ImportError:
+        pass  # graphviz not installed — acceptable
+
+
+# --------------------------------------------------------------------------
+# remat (MXNET_BACKWARD_DO_MIRROR)
+# --------------------------------------------------------------------------
+def test_backward_mirror_same_numerics():
+    rng = np.random.RandomState(4)
+    x = rng.randn(4, 6).astype(np.float32)
+
+    def run():
+        sym = _mlp()
+        exe = sym.simple_bind(mx.cpu(), data=(4, 6), softmax_label=(4,))
+        mx.random.seed(9)
+        ini = mx.init.Xavier()
+        for n, a in exe.arg_dict.items():
+            if n not in ("data", "softmax_label"):
+                ini(mx.init.InitDesc(n), a)
+        exe.arg_dict["data"][:] = mx.nd.array(x)
+        exe.arg_dict["softmax_label"][:] = mx.nd.array(np.zeros(4, np.float32))
+        exe.forward(is_train=True)
+        exe.backward()
+        return exe.grad_dict["fc2_weight"].asnumpy()
+
+    base = run()
+    os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+    try:
+        mirrored = run()
+    finally:
+        del os.environ["MXNET_BACKWARD_DO_MIRROR"]
+    assert_almost_equal(base, mirrored, rtol=1e-5, atol=1e-6)
