@@ -1,0 +1,117 @@
+"""Native (C++) host data plane — build + ctypes bindings.
+
+The reference's data plane is C++ (``src/io/iter_image_recordio_2.cc``:
+RecordIO chunk reads, OpenMP JPEG decode + augment); this package holds the
+TPU-native equivalent (``io_plane.cpp``) and a C predict ABI shim
+(``c_predict_api.cpp``). The shared library builds on demand with the
+system toolchain (g++ + libjpeg, both baked into the image) and callers
+fall back to the pure-python plane when unavailable — same split as the
+reference's USE_OPENCV compile flag.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libmxtpu_io.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        os.path.join(_DIR, "io_plane.cpp"), "-o", _SO, "-ljpeg", "-pthread",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{proc.stderr[-2000:]}")
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            src_mtime = os.path.getmtime(os.path.join(_DIR, "io_plane.cpp"))
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime:
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except (OSError, RuntimeError):
+            return None
+        lib.mxio_scan.restype = ctypes.c_int64
+        lib.mxio_scan.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+        ]
+        lib.mxio_load_batch.restype = ctypes.c_int64
+        lib.mxio_load_batch.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_float, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available():
+    """True when the native plane built and loaded."""
+    return _load() is not None
+
+
+def scan(path):
+    """Record offsets of a .rec file as an int64 array."""
+    lib = _load()
+    n = lib.mxio_scan(path.encode(), None, 0)
+    if n < 0:
+        raise OSError(f"cannot scan {path}")
+    out = np.zeros(n, np.int64)
+    lib.mxio_scan(
+        path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n
+    )
+    return out
+
+
+def load_batch(path, offsets, data_shape, resize=-1, rand_crop=False,
+               rand_mirror=False, mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0),
+               scale=1.0, label_width=1, seed=0, num_threads=4):
+    """Decode + augment a batch: (n,3,H,W) float32 data + (n,label_width)
+    labels. Slots whose decode failed stay zero (count in return value)."""
+    lib = _load()
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    n = len(offsets)
+    c, h, w = data_shape
+    assert c == 3, "native plane decodes RGB"
+    data = np.zeros((n, 3, h, w), np.float32)
+    labels = np.zeros((n, label_width), np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    ok = lib.mxio_load_batch(
+        path.encode(),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, h, w, int(resize), int(bool(rand_crop)), int(bool(rand_mirror)),
+        mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        float(scale), int(label_width), int(seed) & (2**64 - 1),
+        int(num_threads),
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if ok < 0:
+        raise OSError(f"native load_batch failed for {path}")
+    return data, labels, int(ok)

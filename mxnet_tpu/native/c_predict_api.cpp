@@ -1,0 +1,197 @@
+// C predict ABI — the reference's deployment story
+// (include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc): a C program
+// creates a predictor from symbol JSON + a .params blob, sets inputs, runs
+// forward, reads outputs.
+//
+// TPU-native implementation: the shim hosts an embedded CPython interpreter
+// and drives mxnet_tpu.predictor.Predictor — the jax/XLA runtime IS the
+// inference engine, so the native layer is a thin ABI adapter rather than a
+// reimplementation (the same inversion the reference's amalgamation does in
+// reverse).
+//
+// Build: g++ -O3 -shared -fPIC c_predict_api.cpp -o libmxtpu_predict.so \
+//        -I$(python -c 'import sysconfig;print(sysconfig.get_paths()["include"])') \
+//        -lpython3.12 -L/usr/local/lib
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Predictor {
+  PyObject* obj = nullptr;                 // mxnet_tpu.predictor.Predictor
+  std::vector<uint32_t> out_shape;         // scratch for GetOutputShape
+};
+
+std::string g_last_error;
+
+void set_err_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    const char* c = s ? PyUnicode_AsUTF8(s) : nullptr;
+    g_last_error = c ? c : "unknown python error";
+    PyErr_Clear();  // AsUTF8 may itself have raised
+    Py_XDECREF(s);
+  } else {
+    g_last_error = "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+bool ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL the init thread holds, or every later
+    // PyGILState_Ensure from another thread deadlocks (multithreaded
+    // inference servers are the primary ABI consumer)
+    PyEval_SaveThread();
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* PredictorHandle;
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+// Mirrors MXPredCreate (c_predict_api.h): input shapes arrive as a CSR-style
+// (indptr, flat dims) pair per input key.
+int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, PredictorHandle* out) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 0;
+  PyObject* mod = nullptr;
+  PyObject* shapes = nullptr;
+  PyObject* pred = nullptr;
+  do {
+    mod = PyImport_ImportModule("mxnet_tpu.predictor");
+    if (!mod) { set_err_from_python(); rc = -1; break; }
+    shapes = PyDict_New();
+    for (uint32_t i = 0; i < num_input_nodes; ++i) {
+      uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+      PyObject* shp = PyTuple_New(hi - lo);
+      for (uint32_t j = lo; j < hi; ++j)
+        PyTuple_SET_ITEM(shp, j - lo, PyLong_FromLong(input_shape_data[j]));
+      PyDict_SetItemString(shapes, input_keys[i], shp);
+      Py_DECREF(shp);
+    }
+    PyObject* params =
+        PyBytes_FromStringAndSize((const char*)param_bytes, param_size);
+    const char* dev = dev_type == 2 ? "gpu" : "cpu";
+    pred = PyObject_CallMethod(mod, "create_predictor", "sOOsi", symbol_json,
+                               params, shapes, dev, dev_id);
+    Py_DECREF(params);
+    if (!pred) { set_err_from_python(); rc = -1; break; }
+    Predictor* h = new Predictor();
+    h->obj = pred;
+    pred = nullptr;
+    *out = h;
+  } while (false);
+  Py_XDECREF(mod);
+  Py_XDECREF(shapes);
+  Py_XDECREF(pred);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char* key, const float* data,
+                   uint32_t size) {
+  Predictor* h = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* buf = PyBytes_FromStringAndSize((const char*)data,
+                                            size_t(size) * sizeof(float));
+  PyObject* r = PyObject_CallMethod(h->obj, "set_input_bytes", "sO", key, buf);
+  Py_DECREF(buf);
+  int rc = 0;
+  if (!r) { set_err_from_python(); rc = -1; }
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  Predictor* h = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(h->obj, "forward", nullptr);
+  int rc = 0;
+  if (!r) { set_err_from_python(); rc = -1; }
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         uint32_t** shape_data, uint32_t* shape_ndim) {
+  Predictor* h = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(h->obj, "get_output_shape", "I", index);
+  int rc = 0;
+  if (!r) {
+    set_err_from_python();
+    rc = -1;
+  } else {
+    Py_ssize_t n = PySequence_Size(r);
+    h->out_shape.resize(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* it = PySequence_GetItem(r, i);
+      h->out_shape[i] = (uint32_t)PyLong_AsLong(it);
+      Py_DECREF(it);
+    }
+    *shape_data = h->out_shape.data();
+    *shape_ndim = (uint32_t)n;
+  }
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
+                    uint32_t size) {
+  Predictor* h = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(h->obj, "get_output_bytes", "I", index);
+  int rc = 0;
+  if (!r) {
+    set_err_from_python();
+    rc = -1;
+  } else {
+    char* buf;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(r, &buf, &len) == 0 &&
+        (size_t)len == size_t(size) * sizeof(float)) {
+      memcpy(data, buf, len);
+    } else {
+      g_last_error = "output size mismatch";
+      rc = -1;
+    }
+  }
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  Predictor* h = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(h->obj);
+  PyGILState_Release(gil);
+  delete h;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,307 @@
+// Native host-side data plane: RecordIO scan + JPEG decode + augment + pack.
+//
+// The TPU-native counterpart of the reference's C++ pipeline
+// (src/io/iter_image_recordio_2.cc: chunked RecordIO read, OpenMP team JPEG
+// decode + augment into a pinned batch buffer). Python would bottleneck
+// feeding a pod (SURVEY.md §7); this plane does the byte-level and
+// pixel-level work in C++ threads and hands the frontend one packed
+// float32 CHW batch per call.
+//
+// Exposed as a flat C ABI consumed over ctypes (mxnet_tpu/native/__init__.py);
+// no pybind11 dependency by design.
+//
+// Build: g++ -O3 -shared -fPIC io_plane.cpp -o libmxtpu_io.so -ljpeg -pthread
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <csetjmp>
+#include <cmath>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace {
+
+constexpr uint32_t kRecMagic = 0xced7230a;
+
+struct Bytes {
+  std::vector<unsigned char> data;
+};
+
+// ---------------------------------------------------------------------------
+// RecordIO framing (dmlc-compatible: magic, len(+cflag bits), 4-byte pad)
+// ---------------------------------------------------------------------------
+bool read_exact(FILE* f, void* buf, size_t n) {
+  return fread(buf, 1, n, f) == n;
+}
+
+// Read one record at the current position. Returns false on EOF/corrupt.
+bool read_record(FILE* f, Bytes* out) {
+  uint32_t magic, lrec;
+  if (!read_exact(f, &magic, 4) || !read_exact(f, &lrec, 4)) return false;
+  if (magic != kRecMagic) return false;
+  uint32_t cflag = (lrec >> 29) & 7u;
+  uint32_t len = lrec & ((1u << 29) - 1u);
+  size_t padded = (len + 3u) & ~3u;
+  size_t base = out->data.size();
+  out->data.resize(base + padded);
+  if (!read_exact(f, out->data.data() + base, padded)) return false;
+  out->data.resize(base + len);
+  while (cflag == 1u || cflag == 2u) {  // continuation chain
+    if (!read_exact(f, &magic, 4) || !read_exact(f, &lrec, 4)) return false;
+    cflag = (lrec >> 29) & 7u;
+    len = lrec & ((1u << 29) - 1u);
+    padded = (len + 3u) & ~3u;
+    base = out->data.size();
+    out->data.resize(base + padded);
+    if (!read_exact(f, out->data.data() + base, padded)) return false;
+    out->data.resize(base + len);
+    if (cflag == 3u) break;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// JPEG decode via libjpeg with error trampoline
+// ---------------------------------------------------------------------------
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jb, 1);
+}
+
+// Decode to RGB HWC uint8. Returns false on failure.
+bool decode_jpeg(const unsigned char* buf, size_t len, std::vector<unsigned char>* pix,
+                 int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf), len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *h = cinfo.output_height;
+  *w = cinfo.output_width;
+  pix->resize(size_t(*h) * (*w) * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = pix->data() + size_t(cinfo.output_scanline) * (*w) * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Bilinear resize (HWC uint8)
+// ---------------------------------------------------------------------------
+void resize_bilinear(const unsigned char* src, int sh, int sw,
+                     unsigned char* dst, int dh, int dw) {
+  const float ry = dh > 1 ? float(sh - 1) / (dh - 1) : 0.f;
+  const float rx = dw > 1 ? float(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * ry;
+    int y0 = int(fy);
+    int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = x * rx;
+      int x0 = int(fx);
+      int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float p00 = src[(size_t(y0) * sw + x0) * 3 + c];
+        float p01 = src[(size_t(y0) * sw + x1) * 3 + c];
+        float p10 = src[(size_t(y1) * sw + x0) * 3 + c];
+        float p11 = src[(size_t(y1) * sw + x1) * 3 + c];
+        float v = p00 * (1 - wy) * (1 - wx) + p01 * (1 - wy) * wx +
+                  p10 * wy * (1 - wx) + p11 * wy * wx;
+        dst[(size_t(y) * dw + x) * 3 + c] = (unsigned char)(v + 0.5f);
+      }
+    }
+  }
+}
+
+struct AugmentParams {
+  int out_h, out_w;        // crop target
+  int resize_short;        // scale shorter edge to this first; <=0 disables
+  int rand_crop;           // else center crop
+  int rand_mirror;
+  float mean[3], std[3], scale;
+  int label_width;
+};
+
+// One record: IRHeader parse → decode → resize → crop → mirror → normalize →
+// CHW pack into out (3*out_h*out_w floats). Returns false on decode failure.
+bool process_record(const unsigned char* rec, size_t len, const AugmentParams& p,
+                    uint64_t seed, float* out, float* label_out) {
+  // IRHeader: uint32 flag, float label, uint64 id, uint64 id2 (24 bytes)
+  if (len < 24) return false;
+  uint32_t flag;
+  float slabel;
+  memcpy(&flag, rec, 4);
+  memcpy(&slabel, rec + 4, 4);
+  const unsigned char* payload = rec + 24;
+  size_t payload_len = len - 24;
+  if (flag > 0) {  // label vector precedes the image
+    size_t lbytes = size_t(flag) * 4;
+    if (payload_len < lbytes) return false;
+    for (int i = 0; i < p.label_width && i < int(flag); ++i)
+      memcpy(label_out + i, payload + size_t(i) * 4, 4);
+    payload += lbytes;
+    payload_len -= lbytes;
+  } else {
+    label_out[0] = slabel;
+  }
+
+  std::vector<unsigned char> pix;
+  int h = 0, w = 0;
+  if (!decode_jpeg(payload, payload_len, &pix, &h, &w)) return false;
+
+  std::vector<unsigned char> scratch;
+  if (p.resize_short > 0) {
+    int shorter = h < w ? h : w;
+    float s = float(p.resize_short) / shorter;
+    int nh = int(std::lround(h * s)), nw = int(std::lround(w * s));
+    scratch.resize(size_t(nh) * nw * 3);
+    resize_bilinear(pix.data(), h, w, scratch.data(), nh, nw);
+    pix.swap(scratch);
+    h = nh;
+    w = nw;
+  }
+  if (h < p.out_h || w < p.out_w) {  // upscale to cover the crop window
+    int nh = h > p.out_h ? h : p.out_h;
+    int nw = w > p.out_w ? w : p.out_w;
+    scratch.resize(size_t(nh) * nw * 3);
+    resize_bilinear(pix.data(), h, w, scratch.data(), nh, nw);
+    pix.swap(scratch);
+    h = nh;
+    w = nw;
+  }
+
+  std::mt19937_64 rng(seed);
+  int y0, x0;
+  if (p.rand_crop && (h > p.out_h || w > p.out_w)) {
+    y0 = h > p.out_h ? int(rng() % uint64_t(h - p.out_h + 1)) : 0;
+    x0 = w > p.out_w ? int(rng() % uint64_t(w - p.out_w + 1)) : 0;
+  } else {
+    y0 = (h - p.out_h) / 2;
+    x0 = (w - p.out_w) / 2;
+  }
+  bool mirror = p.rand_mirror && (rng() & 1u);
+
+  const size_t plane = size_t(p.out_h) * p.out_w;
+  for (int y = 0; y < p.out_h; ++y) {
+    for (int x = 0; x < p.out_w; ++x) {
+      int sx = mirror ? (p.out_w - 1 - x) : x;
+      const unsigned char* px =
+          pix.data() + (size_t(y0 + y) * w + (x0 + sx)) * 3;
+      for (int c = 0; c < 3; ++c) {
+        out[size_t(c) * plane + size_t(y) * p.out_w + x] =
+            (float(px[c]) - p.mean[c]) / p.std[c] * p.scale;
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan a .rec file; writes up to cap record offsets. Returns total count
+// (call once with cap=0 to size, then again), or -1 on error.
+int64_t mxio_scan(const char* path, int64_t* offsets, int64_t cap) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  int64_t n = 0;
+  for (;;) {
+    long pos = ftell(f);
+    Bytes rec;
+    if (!read_record(f, &rec)) break;
+    if (n < cap && offsets) offsets[n] = pos;
+    ++n;
+  }
+  fclose(f);
+  return n;
+}
+
+// Load + decode + augment a batch. data_out: (n, 3, out_h, out_w) float32;
+// label_out: (n, label_width) float32. Returns number of records decoded
+// successfully (failed decodes leave zero-filled slots), or -1 on IO error.
+int64_t mxio_load_batch(const char* path, const int64_t* offsets, int64_t n,
+                        int out_h, int out_w, int resize_short, int rand_crop,
+                        int rand_mirror, const float* mean, const float* stdv,
+                        float scale, int label_width, uint64_t seed,
+                        int num_threads, float* data_out, float* label_out) {
+  // Stage 1 (serial): byte reads — one file handle, sequential seeks.
+  std::vector<Bytes> raw(n);
+  {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    for (int64_t i = 0; i < n; ++i) {
+      if (fseek(f, long(offsets[i]), SEEK_SET) != 0 ||
+          !read_record(f, &raw[i])) {
+        fclose(f);
+        return -1;
+      }
+    }
+    fclose(f);
+  }
+
+  AugmentParams p;
+  p.out_h = out_h;
+  p.out_w = out_w;
+  p.resize_short = resize_short;
+  p.rand_crop = rand_crop;
+  p.rand_mirror = rand_mirror;
+  memcpy(p.mean, mean, sizeof p.mean);
+  memcpy(p.std, stdv, sizeof p.std);
+  p.scale = scale;
+  p.label_width = label_width;
+
+  const size_t img_elems = size_t(3) * out_h * out_w;
+  memset(data_out, 0, sizeof(float) * img_elems * n);
+  memset(label_out, 0, sizeof(float) * size_t(label_width) * n);
+
+  // Stage 2 (parallel): decode + augment, the reference's OpenMP team.
+  std::atomic<int64_t> next(0), ok(0);
+  int workers = num_threads > 0 ? num_threads : 4;
+  if (workers > n) workers = int(n);
+  std::vector<std::thread> pool;
+  for (int t = 0; t < workers; ++t) {
+    pool.emplace_back([&]() {
+      for (;;) {
+        int64_t i = next.fetch_add(1);
+        if (i >= n) return;
+        if (process_record(raw[i].data.data(), raw[i].data.size(), p,
+                           seed + uint64_t(i) * 0x9e3779b97f4a7c15ull,
+                           data_out + img_elems * i,
+                           label_out + size_t(label_width) * i)) {
+          ok.fetch_add(1);
+        }
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+  return ok.load();
+}
+
+}  // extern "C"

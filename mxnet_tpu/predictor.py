@@ -111,7 +111,31 @@ class Predictor:
     def num_outputs(self):
         return len(self._exec.outputs)
 
+    # --- flat-buffer accessors used by the C predict shim ----------------
+    # (mxnet_tpu/native/c_predict_api.cpp marshals raw float32 buffers
+    # across the ABI like the reference MXPredSetInput/MXPredGetOutput)
+    def set_input_bytes(self, name, buf):
+        shape = self.input_shapes[name]
+        arr = np.frombuffer(buf, np.float32).reshape(shape)
+        self.set_input(name, arr)
+
+    def get_output_shape(self, index):
+        return tuple(self._exec.outputs[index].shape)
+
+    def get_output_bytes(self, index):
+        out = self.get_output(index)
+        return np.ascontiguousarray(out, np.float32).tobytes()
+
 
 def load_ndarray_file(nd_bytes_or_file):
     """Reference MXNDListCreate: load a params blob to a dict."""
     return nd_load(nd_bytes_or_file)
+
+
+def create_predictor(symbol_json, param_bytes, input_shapes, dev_type="cpu",
+                     dev_id=0):
+    """Entry point for the C predict shim (MXPredCreate marshalling)."""
+    return Predictor(
+        symbol_json, param_bytes, input_shapes,
+        dev_type=dev_type, dev_id=dev_id,
+    )

@@ -235,9 +235,8 @@ class ImageRecordIter:
                  max_random_scale=1.0, min_random_scale=1.0,
                  part_index=0, num_parts=1, preprocess_threads=None,
                  round_batch=True, seed=0, data_name="data",
-                 label_name="softmax_label", path_imgidx=None, **kwargs):
-        import cv2  # noqa: F401 — fail early if decode backend missing
-
+                 label_name="softmax_label", path_imgidx=None,
+                 use_native=None, **kwargs):
         self.path_imgrec = path_imgrec
         self.data_shape = tuple(data_shape)
         self.batch_size = batch_size
@@ -256,21 +255,49 @@ class ImageRecordIter:
 
         if preprocess_threads is None:
             preprocess_threads = _env.get("MXNET_CPU_WORKER_NTHREADS")
-        self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+        self._threads = preprocess_threads
 
-        # index all record offsets once (sequential scan)
-        self._offsets = []
-        rec = MXRecordIO(path_imgrec, "r")
-        while True:
-            pos = rec.tell()
-            buf = rec.read()
-            if buf is None:
-                break
-            self._offsets.append(pos)
-        rec.close()
-        # shard for distributed workers (reference InputSplit part_index)
-        self._offsets = self._offsets[part_index::num_parts]
-        self._rec = MXRecordIO(path_imgrec, "r")
+        # native (C++) plane: RecordIO scan + libjpeg decode + augment + pack
+        # (the reference's iter_image_recordio_2.cc pipeline); python/cv2
+        # plane is the fallback and the path for features the native plane
+        # doesn't cover (non-RGB shapes)
+        from . import native as _native
+
+        if use_native is None:
+            use_native = self.data_shape[0] == 3 and _native.available()
+        elif use_native:
+            # explicit request must not silently degrade to the python path
+            if not _native.available():
+                raise MXNetError(
+                    "use_native=True but the native plane is unavailable "
+                    "(g++/libjpeg build failed)"
+                )
+            if self.data_shape[0] != 3:
+                raise MXNetError(
+                    "use_native=True requires 3-channel RGB data_shape"
+                )
+        self._native = bool(use_native)
+        if self._native:
+            self._offsets = _native.scan(path_imgrec)[part_index::num_parts]
+            self._rec = None
+            self._pool = None
+        else:
+            import cv2  # noqa: F401 — fail early if decode backend missing
+
+            self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+            # index all record offsets once (sequential scan)
+            self._offsets = []
+            rec = MXRecordIO(path_imgrec, "r")
+            while True:
+                pos = rec.tell()
+                buf = rec.read()
+                if buf is None:
+                    break
+                self._offsets.append(pos)
+            rec.close()
+            # shard for distributed workers (reference InputSplit part_index)
+            self._offsets = self._offsets[part_index::num_parts]
+            self._rec = MXRecordIO(path_imgrec, "r")
         self._order = np.arange(len(self._offsets))
         self.reset()
 
@@ -347,6 +374,8 @@ class ImageRecordIter:
             raise StopIteration
         idxs = self._order[self._cursor:self._cursor + self.batch_size]
         self._cursor += self.batch_size
+        if self._native:
+            return self._fetch_native(idxs)
         seeds = self.rs.randint(0, 2 ** 31 - 1, size=len(idxs))
         results = list(
             self._pool.map(
@@ -365,6 +394,36 @@ class ImageRecordIter:
         )
 
     _cur = None
+
+    def _fetch_native(self, idxs):
+        from . import native as _native
+        from .io import DataBatch
+        from .ndarray import array
+
+        data, labels, ok = _native.load_batch(
+            self.path_imgrec,
+            np.asarray(self._offsets, np.int64)[idxs],
+            self.data_shape,
+            resize=self.resize,
+            rand_crop=self.rand_crop,
+            rand_mirror=self.rand_mirror,
+            mean=self.mean, std=self.std, scale=self.scale,
+            label_width=self.label_width,
+            seed=int(self.rs.randint(0, 2 ** 31 - 1)),
+            num_threads=self._threads,
+        )
+        if ok < len(idxs):
+            # undecodable records would otherwise train as all-zero images
+            raise MXNetError(
+                f"{self.path_imgrec}: {len(idxs) - ok} record(s) failed to "
+                "decode on the native plane (libjpeg handles JPEG only); "
+                "pass use_native=False for other image formats"
+            )
+        label = labels[:, 0] if self.label_width == 1 else labels
+        return DataBatch(
+            data=[array(data)], label=[array(label)], pad=0, index=None,
+            provide_data=self.provide_data, provide_label=self.provide_label,
+        )
 
     # --- DataIter protocol (iter_next advances; getdata reads current) ----
     def next(self):
