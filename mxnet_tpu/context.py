@@ -80,6 +80,11 @@ class Context:
             if devs and devs[0].platform == "cpu" and self.device_type == "tpu":
                 # CPU-only test environment: tpu(i) falls back to cpu(i).
                 pass
+        if jax.process_count() > 1:
+            # multi-host: device ids index THIS process's devices (the
+            # reference's dev_id is per-worker); the global list would
+            # resolve rank>0 contexts to other hosts' devices
+            devs = [d for d in devs if d.process_index == jax.process_index()]
         if self.device_id >= len(devs):
             raise MXNetError(
                 f"{self} out of range: backend has {len(devs)} devices"

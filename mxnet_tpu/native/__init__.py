@@ -26,13 +26,22 @@ _tried = False
 
 
 def _build():
+    # build to a per-process temp name, then atomically rename: several
+    # launched ranks may race to build, and a half-written .so must never
+    # be dlopen-able at the canonical path
+    tmp = f"{_SO}.build.{os.getpid()}"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-        os.path.join(_DIR, "io_plane.cpp"), "-o", _SO, "-ljpeg", "-pthread",
+        os.path.join(_DIR, "io_plane.cpp"), "-o", tmp, "-ljpeg", "-pthread",
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         raise RuntimeError(f"native build failed:\n{proc.stderr[-2000:]}")
+    os.replace(tmp, _SO)
 
 
 def _load():

@@ -17,6 +17,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -27,7 +28,8 @@ struct Predictor {
   std::vector<uint32_t> out_shape;         // scratch for GetOutputShape
 };
 
-std::string g_last_error;
+// per-thread like the reference's thread-local error string (c_api_error.cc)
+thread_local std::string g_last_error;
 
 void set_err_from_python() {
   PyObject *type, *value, *tb;
@@ -46,14 +48,19 @@ void set_err_from_python() {
   Py_XDECREF(tb);
 }
 
+std::once_flag g_init_once;
+
 bool ensure_python() {
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    // release the GIL the init thread holds, or every later
-    // PyGILState_Ensure from another thread deadlocks (multithreaded
-    // inference servers are the primary ABI consumer)
-    PyEval_SaveThread();
-  }
+  // once_flag: two threads racing into MXPredCreate must not double-init
+  std::call_once(g_init_once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL the init thread holds, or every later
+      // PyGILState_Ensure from another thread deadlocks (multithreaded
+      // inference servers are the primary ABI consumer)
+      PyEval_SaveThread();
+    }
+  });
   return true;
 }
 

@@ -227,15 +227,37 @@ bool process_record(const unsigned char* rec, size_t len, const AugmentParams& p
 extern "C" {
 
 // Scan a .rec file; writes up to cap record offsets. Returns total count
-// (call once with cap=0 to size, then again), or -1 on error.
+// (call once with cap=0 to size, then again), or -1 on error. Payloads are
+// fseek'd past, not read — the scan touches only the 8-byte frame headers,
+// so indexing a multi-GB .rec costs metadata reads, not a full pass.
 int64_t mxio_scan(const char* path, int64_t* offsets, int64_t cap) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
   int64_t n = 0;
   for (;;) {
     long pos = ftell(f);
-    Bytes rec;
-    if (!read_record(f, &rec)) break;
+    uint32_t magic, lrec;
+    if (!read_exact(f, &magic, 4) || !read_exact(f, &lrec, 4)) break;
+    if (magic != kRecMagic) break;
+    uint32_t cflag = (lrec >> 29) & 7u;
+    uint32_t len = lrec & ((1u << 29) - 1u);
+    if (fseek(f, long((len + 3u) & ~3u), SEEK_CUR) != 0) break;
+    bool bad = false;
+    while (cflag == 1u || cflag == 2u) {  // continuation chain
+      if (!read_exact(f, &magic, 4) || !read_exact(f, &lrec, 4) ||
+          magic != kRecMagic) {
+        bad = true;
+        break;
+      }
+      cflag = (lrec >> 29) & 7u;
+      len = lrec & ((1u << 29) - 1u);
+      if (fseek(f, long((len + 3u) & ~3u), SEEK_CUR) != 0) {
+        bad = true;
+        break;
+      }
+      if (cflag == 3u) break;
+    }
+    if (bad) break;
     if (n < cap && offsets) offsets[n] = pos;
     ++n;
   }

@@ -24,6 +24,65 @@ def _free_port():
     return port
 
 
+def test_dist_training_converges_identically():
+    """dist_lenet analogue: 2 ranks train on disjoint shards through the
+    dist kvstore; both converge and end with identical parameters."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+        "-n", "2", "--launcher", "local", "--port", str(_free_port()),
+        sys.executable, os.path.join(_ROOT, "tests", "dist_train_worker.py"),
+    ]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"dist training failed:\n{out[-4000:]}"
+    for r in range(2):
+        assert f"rank {r}/2 DIST-TRAIN OK" in out, out[-4000:]
+
+
+def test_launcher_detects_and_restarts_dead_worker(tmp_path):
+    """Failure detection: a rank that dies once is restarted by the local
+    supervisor (the ps-lite scheduler-liveness + is_recovery analogue)."""
+    marker = str(tmp_path / "died_once")
+    script = str(tmp_path / "flaky.py")
+    with open(script, "w") as f:
+        f.write(
+            "import os, sys\n"
+            f"marker = {marker!r}\n"
+            "rank = os.environ['MXNET_PROC_ID']\n"
+            "if rank == '1' and not os.path.exists(marker):\n"
+            "    open(marker, 'w').close()\n"
+            "    sys.exit(3)  # simulated crash on first life\n"
+            "print(f'rank {rank} alive', flush=True)\n"
+        )
+    env = dict(os.environ)
+    cmd = [
+        sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+        "-n", "2", "--launcher", "local", "--port", str(_free_port()),
+        "--max-restarts", "1",
+        sys.executable, script,
+    ]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=120)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    assert "rank 1 died" in out and "restart 1/1" in out, out
+    assert out.count("rank 1 alive") == 1
+
+    # with no restart budget the job fails and reports the dead rank
+    os.unlink(marker)
+    cmd[cmd.index("--max-restarts") + 1] = "0"
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=120)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode != 0
+    assert "no restarts left" in out
+
+
 @pytest.mark.parametrize("nproc", [2, 3])
 def test_dist_sync_kvstore_local_processes(nproc):
     env = dict(os.environ)

@@ -22,6 +22,70 @@ import subprocess
 import sys
 
 
+def _worker_env(rank, num_workers, coordinator):
+    env = dict(os.environ)
+    env.update({
+        "MXNET_COORDINATOR": coordinator,
+        "MXNET_NUM_PROCS": str(num_workers),
+        "MXNET_PROC_ID": str(rank),
+        # reference-compatible names some scripts read:
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_WORKER_ID": str(rank),
+    })
+    return env
+
+
+def _supervise_local(command, num_workers, coordinator, max_restarts):
+    """Run + monitor local workers; restart failed ranks (the launcher-level
+    failure detection the reference gets from the ps-lite scheduler's
+    liveness tracking + is_recovery restart path, kvstore_dist.h:177-195).
+
+    A worker that exits non-zero is relaunched with the same rank env, up
+    to ``max_restarts`` times per rank. NOTE: a restarted rank only re-syncs
+    state because every rank runs the same program from its own entry —
+    scripts that need mid-training recovery must checkpoint/resume
+    (--load-epoch pattern); the launcher guarantees detection + relaunch.
+    """
+    import time
+
+    procs = {}
+    restarts = {r: 0 for r in range(num_workers)}
+    for rank in range(num_workers):
+        procs[rank] = subprocess.Popen(
+            command, env=_worker_env(rank, num_workers, coordinator)
+        )
+    failed = False
+    while procs:
+        time.sleep(0.2)
+        for rank, p in list(procs.items()):
+            rc = p.poll()
+            if rc is None:
+                continue
+            del procs[rank]
+            if rc == 0:
+                continue
+            if restarts[rank] < max_restarts:
+                restarts[rank] += 1
+                sys.stderr.write(
+                    f"launch.py: rank {rank} died (rc={rc}); restart "
+                    f"{restarts[rank]}/{max_restarts}\n"
+                )
+                procs[rank] = subprocess.Popen(
+                    command, env=_worker_env(rank, num_workers, coordinator)
+                )
+            else:
+                sys.stderr.write(
+                    f"launch.py: rank {rank} dead (rc={rc}), no restarts "
+                    "left — terminating the job\n"
+                )
+                failed = True
+                for q in procs.values():
+                    q.terminate()
+                procs.clear()
+                break
+    return 1 if failed else 0
+
+
 def main():
     parser = argparse.ArgumentParser(description="Launch a distributed job")
     parser.add_argument("-n", "--num-workers", type=int, required=True)
@@ -29,6 +93,8 @@ def main():
     parser.add_argument("--launcher", type=str, default="local",
                         choices=["local", "ssh"])
     parser.add_argument("--port", type=int, default=9127)
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="restarts per failed rank (local launcher)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.command:
@@ -41,27 +107,21 @@ def main():
         assert len(hosts) >= args.num_workers
 
     coordinator = f"{hosts[0]}:{args.port}"
+    if args.launcher == "local":
+        sys.exit(_supervise_local(
+            args.command, args.num_workers, coordinator, args.max_restarts
+        ))
+
     procs = []
     for rank in range(args.num_workers):
-        env = dict(os.environ)
-        env.update({
-            "MXNET_COORDINATOR": coordinator,
-            "MXNET_NUM_PROCS": str(args.num_workers),
-            "MXNET_PROC_ID": str(rank),
-            # reference-compatible names some scripts read:
-            "DMLC_NUM_WORKER": str(args.num_workers),
-            "DMLC_WORKER_ID": str(rank),
-        })
-        if args.launcher == "local":
-            procs.append(subprocess.Popen(args.command, env=env))
-        else:
-            remote_env = " ".join(
-                f"{k}={v}" for k, v in env.items()
-                if k.startswith(("MXNET_", "DMLC_"))
-            )
-            cmd = ["ssh", hosts[rank],
-                   f"cd {os.getcwd()} && {remote_env} {' '.join(args.command)}"]
-            procs.append(subprocess.Popen(cmd))
+        env = _worker_env(rank, args.num_workers, coordinator)
+        remote_env = " ".join(
+            f"{k}={v}" for k, v in env.items()
+            if k.startswith(("MXNET_", "DMLC_"))
+        )
+        cmd = ["ssh", hosts[rank],
+               f"cd {os.getcwd()} && {remote_env} {' '.join(args.command)}"]
+        procs.append(subprocess.Popen(cmd))
 
     code = 0
     for p in procs:
