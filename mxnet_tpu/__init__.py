@@ -62,6 +62,7 @@ from . import executor
 from .executor import Executor
 from . import attribute
 from .attribute import AttrScope
+from . import engine
 from . import name
 from .name import NameManager
 

@@ -96,6 +96,17 @@ class Context:
         # the reference's pooled storage manager release.
         return None
 
+    def memory_stats(self):
+        """Device memory statistics from the PJRT allocator — the storage
+        manager's stats surface (reference GPUPooledStorageManager pool
+        accounting). Keys are backend-defined (e.g. bytes_in_use,
+        peak_bytes_in_use); {} when the backend doesn't report."""
+        dev = self.jax_device()  # invalid contexts raise, as elsewhere
+        try:
+            return dict(dev.memory_stats() or {})
+        except (AttributeError, NotImplementedError):
+            return {}  # backend doesn't report stats
+
 
 def cpu(device_id=0):
     return Context("cpu", device_id)

@@ -1,0 +1,105 @@
+"""Engine facade — the dependency-engine API surface.
+
+Reference: ``include/mxnet/engine.h`` (``Engine::Get()`` with
+``Push/PushSync/NewVariable/WaitForVar/WaitForAll``) + the selectable
+backends (``src/engine/engine.cc:14-27``). On TPU the ordering the
+threaded engine enforced by hand comes from jax's async dispatch: data
+dependencies order device work, so the facade's job is (a) API parity for
+scripts/tests that talk to the engine, (b) host-side callbacks that must
+run after device work (``push`` closures), (c) the global barrier.
+
+Engine *type* maps to the execution mode: ``NaiveEngine`` (synchronous
+un-jitted interpret execution, the reference's debug engine) vs the
+default lazy jitted path — selected via ``MXNET_ENGINE_TYPE``, read at
+executor bind (mxnet_tpu/executor.py).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import env as _env
+
+
+class _Var:
+    """An engine variable — identity token guarding an NDArray's buffer.
+
+    The reference serialises conflicting reads/writes through these; here
+    jax data flow does the device-side ordering, so a Var carries only the
+    identity + an optional host-side condition used by ``wait_for_var``.
+    """
+
+    __slots__ = ("_arrays",)
+
+    def __init__(self):
+        self._arrays = []
+
+    def attach(self, nd):
+        self._arrays.append(nd)
+
+
+class Engine:
+    """Process-wide engine facade (``Engine::Get()``)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls):
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @property
+    def type(self):
+        return _env.get("MXNET_ENGINE_TYPE")
+
+    # --- variables -----------------------------------------------------
+    def new_variable(self):
+        return _Var()
+
+    # --- execution -----------------------------------------------------
+    def push(self, fn, read_vars=(), write_vars=()):
+        """Run a host closure ordered AFTER pending device work on the
+        read/write sets (reference Engine::PushSync semantics: the closure
+        sees settled values)."""
+        import jax
+
+        for var in tuple(read_vars) + tuple(write_vars):
+            for nd in getattr(var, "_arrays", ()):
+                jax.block_until_ready(nd._data)
+        fn()
+
+    push_sync = push
+
+    def wait_for_var(self, var):
+        import jax
+
+        for nd in getattr(var, "_arrays", ()):
+            jax.block_until_ready(nd._data)
+
+    def wait_for_all(self):
+        import jax
+
+        jax.effects_barrier()
+
+    # --- bulk-exec knobs (reference set_bulk_size) ----------------------
+    def set_bulk_size(self, size):
+        """Reference tunes how many engine ops fuse into one segment and
+        returns the PREVIOUS size; here whole graphs are always one XLA
+        program, so the only meaningful setting is 0 — which genuinely
+        disables the fused train step (sets MXNET_EXEC_BULK_EXEC_TRAIN=0,
+        read by Module at each update)."""
+        import os
+
+        prev = getattr(self, "_bulk_size", None)
+        if prev is None:
+            prev = 15 if _env.get("MXNET_EXEC_BULK_EXEC_TRAIN") else 0
+        self._bulk_size = int(size)
+        os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"] = "0" if size == 0 else "1"
+        return prev
+
+
+def get():
+    return Engine.get()

@@ -214,6 +214,7 @@ register(
     arg_names=lambda p: ["data", "weight"] + ([] if p["no_bias"] else ["bias"]),
     param_schema=dict(_CONV_SCHEMA),
     fill_in_shapes=_conv_fill,
+    aliases=("Convolution_v1",),  # legacy twin (src/operator/convolution_v1)
 )
 
 
@@ -412,6 +413,7 @@ register(
         "cudnn_off": Param(parse_bool, False),
         "axis": Param(parse_int, 1),
     },
+    aliases=("BatchNorm_v1",),  # legacy twin (src/operator/batch_norm_v1)
     fill_in_shapes=_bn_fill,
     num_outputs=3,
     num_visible_outputs=lambda p: 3 if p["output_mean_var"] else 1,
@@ -557,6 +559,7 @@ register(
         "pooling_convention": Param(parse_str, "valid"),
         "cudnn_off": Param(parse_bool, False),
     },
+    aliases=("Pooling_v1",),  # legacy twin (src/operator/pooling_v1)
 )
 
 

@@ -48,6 +48,49 @@ def test_env_check_unknown():
 
 
 # --------------------------------------------------------------------------
+# engine facade + storage stats
+# --------------------------------------------------------------------------
+def test_engine_facade():
+    eng = mx.engine.get()
+    assert eng is mx.engine.get()  # singleton
+    assert isinstance(eng.type, str)
+    a = mx.nd.ones((4,)) * 3
+    var = eng.new_variable()
+    var.attach(a)
+    ran = []
+    eng.push(lambda: ran.append(float(a.asnumpy().sum())), read_vars=[var])
+    assert ran == [12.0]
+    eng.wait_for_var(var)
+    eng.wait_for_all()
+    # set_bulk_size returns the PREVIOUS size (reference semantics) and 0
+    # genuinely disables the fused train step via the env toggle
+    prev = eng.set_bulk_size(0)
+    assert os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"] == "0"
+    assert eng.set_bulk_size(prev) == 0
+    assert os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"] == "1"
+
+
+def test_context_memory_stats():
+    stats = mx.cpu().memory_stats()
+    assert isinstance(stats, dict)  # keys backend-defined; may be empty
+
+
+def test_v1_op_aliases():
+    """Legacy *_v1 twins resolve to the modern layers (reference
+    convolution_v1/pooling_v1/batch_norm_v1 registrations)."""
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution_v1(data, num_filter=2, kernel=(3, 3), name="c")
+    p = mx.sym.Pooling_v1(c, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    exe = p.simple_bind(mx.cpu(), data=(1, 2, 8, 8))
+    for n, a in exe.arg_dict.items():
+        if n != "data":
+            a[:] = mx.nd.ones(a.shape) * 0.1
+    exe.arg_dict["data"][:] = mx.nd.ones((1, 2, 8, 8))
+    out = exe.forward()[0]
+    assert out.shape == (1, 2, 3, 3)
+
+
+# --------------------------------------------------------------------------
 # NaiveEngine sync-debug toggle (reference engine.cc:14-27)
 # --------------------------------------------------------------------------
 def test_naive_engine_matches_default():
