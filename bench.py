@@ -86,7 +86,8 @@ def main():
         # Peak is per device kind (bf16); unknown kinds omit the field
         # rather than report against the wrong denominator.
         peaks_tflops = {"TPU v5 lite": 197, "TPU v5e": 197,
-                        "TPU v4": 275, "TPU v5p": 459, "TPU v6e": 918}
+                        "TPU v4": 275, "TPU v5p": 459,
+                        "TPU v6 lite": 918, "TPU v6e": 918}
         kind = getattr(jax.devices()[0], "device_kind", "")
         peak = next((v for k, v in peaks_tflops.items() if k in kind), None)
         if peak:

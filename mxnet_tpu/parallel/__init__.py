@@ -17,3 +17,8 @@ from .mesh import (
     with_mesh,
 )
 from .ring_attention import ring_attention, sequence_parallel_sharding
+from .tensor_parallel import (
+    column_parallel_spec,
+    row_parallel_spec,
+    tp_mlp,
+)

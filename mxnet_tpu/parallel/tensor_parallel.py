@@ -1,0 +1,72 @@
+"""Tensor (intra-op) parallelism helpers.
+
+NEW surface relative to the reference (SURVEY.md §2.5 marks tensor
+parallelism absent there): Megatron-style sharded projections expressed as
+sharding annotations over a named mesh axis — XLA inserts the collectives
+over ICI. The two standard layouts:
+
+* ``column_parallel``: weight (out, in) sharded on the OUT axis; each shard
+  computes its slice of the output, no collective on the forward (the
+  following row-parallel layer consumes the sharded activation directly).
+* ``row_parallel``: weight sharded on the IN axis over tp; each shard
+  contracts its input slice and a ``psum`` over tp produces the full
+  output — one all-reduce per layer pair, the Megatron recipe.
+
+These compose with ``dp`` batch sharding on the same mesh: annotate, jit,
+and XLA partitions the program across the full mesh.
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+
+
+def column_parallel_spec(mesh_axis="tp"):
+    """PartitionSpec for a column-parallel (out, in) weight."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(mesh_axis, None)
+
+
+def row_parallel_spec(mesh_axis="tp"):
+    """PartitionSpec for a row-parallel (out, in) weight."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(None, mesh_axis)
+
+
+def tp_mlp(x, w1, w2, mesh, tp_axis="tp", dp_axis=None):
+    """A 2-layer Megatron-sharded MLP block: column-parallel w1 (out
+    sharded), gelu, row-parallel w2 (in sharded) with the closing psum —
+    expressed purely through shardings; XLA chooses the collectives.
+
+    ``x``: (batch, d_model); ``w1``: (d_ff, d_model); ``w2``: (d_model,
+    d_ff). Returns (batch, d_model) replicated over tp (sharded over dp if
+    ``dp_axis`` given).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if tp_axis not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {tp_axis!r}")
+    if dp_axis is not None and dp_axis not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {dp_axis!r}")
+    xspec = P(dp_axis, None)
+    x = jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, xspec)
+    )
+    w1 = jax.lax.with_sharding_constraint(
+        w1, NamedSharding(mesh, column_parallel_spec(tp_axis))
+    )
+    w2 = jax.lax.with_sharding_constraint(
+        w2, NamedSharding(mesh, row_parallel_spec(tp_axis))
+    )
+    h = jax.nn.gelu(x @ w1.T)  # (batch, d_ff) — d_ff sharded over tp
+    h = jax.lax.with_sharding_constraint(
+        h, NamedSharding(mesh, P(dp_axis, tp_axis))
+    )
+    out = h @ w2.T  # contraction over the tp-sharded d_ff → XLA psums
+    return jax.lax.with_sharding_constraint(
+        out, NamedSharding(mesh, xspec)
+    )
