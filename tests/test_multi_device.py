@@ -188,6 +188,13 @@ def test_tensor_parallel_mlp_matches_unsharded():
     for g, rg in zip(grads, ref_grads):
         assert_almost_equal(np.asarray(g), np.asarray(rg), rtol=1e-4,
                             atol=1e-5)
+    # the computation must actually be tensor-parallel: the row-parallel
+    # contraction forces an all-reduce in the compiled program
+    hlo = (
+        jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))
+        .lower(w1, w2).compile().as_text()
+    )
+    assert "all-reduce" in hlo, "no all-reduce: tp sharding was dropped"
 
 
 def test_model_parallel_diamond_join():

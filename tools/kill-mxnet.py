@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Kill stray training processes on this host (reference tools/kill-mxnet.py).
+
+The reference greps for its python trainers and SIGKILLs them after a failed
+distributed run; same job here for workers launched by tools/launch.py.
+
+  python tools/kill-mxnet.py               # kill launched mxnet_tpu workers
+  python tools/kill-mxnet.py my_train.py   # kill by script name instead
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+
+
+def main():
+    needle = sys.argv[1] if len(sys.argv) > 1 else None
+    me = os.getpid()
+    killed = []
+    for pid in filter(str.isdigit, os.listdir("/proc")):
+        pid = int(pid)
+        if pid == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\x00", b" ").decode(errors="ignore")
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                env = f.read().decode(errors="ignore")
+        except OSError:
+            continue
+        launched = "MXNET_COORDINATOR=" in env and "MXNET_PROC_ID=" in env
+        matches = needle is not None and needle in cmd and "python" in cmd
+        if launched or matches:
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed.append((pid, cmd.strip()[:80]))
+            except OSError:
+                pass
+    for pid, cmd in killed:
+        print(f"killed {pid}: {cmd}")
+    if not killed:
+        print("no matching processes")
+
+
+if __name__ == "__main__":
+    main()
