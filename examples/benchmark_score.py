@@ -26,12 +26,19 @@ def get_symbol(network, **kwargs):
     if network.startswith("resnet-"):
         return models.resnet(num_classes=1000,
                              num_layers=int(network.split("-")[1]), **kwargs)
-    if network == "vgg":
-        return models.vgg(num_classes=1000)
-    if network == "inception-bn":
-        return models.inception_bn(num_classes=1000)
-    if network == "mlp":
-        return models.mlp()
+    if network.startswith("resnext-"):
+        return models.resnext(num_classes=1000,
+                              num_layers=int(network.split("-")[1]))
+    factories = {
+        "vgg": models.vgg,
+        "inception-bn": models.inception_bn,
+        "inception-v3": models.inception_v3,
+        "googlenet": models.googlenet,
+        "alexnet": models.alexnet,
+        "mlp": lambda num_classes: models.mlp(),
+    }
+    if network in factories:
+        return factories[network](num_classes=1000)
     raise ValueError(f"unknown network {network}")
 
 

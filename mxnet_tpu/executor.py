@@ -33,7 +33,7 @@ import numpy as np
 
 from .base import MXNetError, np_dtype
 from .context import Context, current_context
-from .ndarray import NDArray, zeros as nd_zeros
+from .ndarray import NDArray, ones as nd_ones, zeros as nd_zeros
 from .ops.registry import OpMode
 
 _GRAD_REQ = ("write", "add", "null")
@@ -778,6 +778,12 @@ class Executor:
             if shared_exec is not None and n in shared_exec.aux_dict and \
                     tuple(shared_exec.aux_dict[n].shape) == tuple(s):
                 aux_states[n] = shared_exec.aux_dict[n]
+            elif n.endswith(("moving_var", "running_var")):
+                # matches the initializer's exact heuristic (initializer.py
+                # _init_default): zero variances make an un-init'd eval
+                # forward amplify by 1/sqrt(eps) per BatchNorm and overflow
+                # on deep nets; moving_inv_var and other aux stay zero
+                aux_states[n] = nd_ones(s, ctx=ctx, dtype=d)
             else:
                 aux_states[n] = nd_zeros(s, ctx=ctx, dtype=d)
         return Executor(

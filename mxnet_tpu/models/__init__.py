@@ -13,6 +13,10 @@ from .lenet import get_symbol as lenet
 from .resnet import get_symbol as resnet
 from .vgg import get_symbol as vgg
 from .inception_bn import get_symbol as inception_bn
+from .alexnet import get_symbol as alexnet
+from .googlenet import get_symbol as googlenet
+from .inception_v3 import get_symbol as inception_v3
+from .resnext import get_symbol as resnext
 from .dcgan import make_generator as dcgan_generator
 from .dcgan import make_discriminator as dcgan_discriminator
 from .lstm_lm import lstm_lm_sym_gen

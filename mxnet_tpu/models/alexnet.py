@@ -1,0 +1,34 @@
+"""AlexNet (reference example/image-classification/symbols/alexnet.py —
+the single-tower variant used for the reference's throughput baselines)."""
+
+from .. import symbol as sym
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    data = sym.Variable("data")
+
+    def conv_relu(x, name, num_filter, kernel, stride=(1, 1), pad=(0, 0)):
+        x = sym.Convolution(x, num_filter=num_filter, kernel=kernel,
+                            stride=stride, pad=pad, name=name)
+        return sym.Activation(x, act_type="relu", name=f"{name}_relu")
+
+    def lrn_pool(x, name):
+        x = sym.LRN(x, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0,
+                    name=f"{name}_lrn")
+        return sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max",
+                           name=f"{name}_pool")
+
+    x = lrn_pool(conv_relu(data, "conv1", 96, (11, 11), stride=(4, 4)), "s1")
+    x = lrn_pool(conv_relu(x, "conv2", 256, (5, 5), pad=(2, 2)), "s2")
+    x = conv_relu(x, "conv3", 384, (3, 3), pad=(1, 1))
+    x = conv_relu(x, "conv4", 384, (3, 3), pad=(1, 1))
+    x = conv_relu(x, "conv5", 256, (3, 3), pad=(1, 1))
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max",
+                    name="pool5")
+    x = sym.Flatten(x)
+    for i in (6, 7):
+        x = sym.FullyConnected(x, num_hidden=4096, name=f"fc{i}")
+        x = sym.Activation(x, act_type="relu", name=f"relu{i}")
+        x = sym.Dropout(x, p=0.5, name=f"drop{i}")
+    x = sym.FullyConnected(x, num_hidden=num_classes, name=f"fc8")
+    return sym.SoftmaxOutput(x, name="softmax")

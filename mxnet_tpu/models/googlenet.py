@@ -1,0 +1,46 @@
+"""GoogLeNet / Inception-v1 (reference symbols/googlenet.py)."""
+
+from .. import symbol as sym
+
+
+def _conv(x, num_filter, kernel, stride=(1, 1), pad=(0, 0), name=None):
+    x = sym.Convolution(x, num_filter=num_filter, kernel=kernel,
+                        stride=stride, pad=pad, name=f"{name}_conv")
+    return sym.Activation(x, act_type="relu", name=f"{name}_relu")
+
+
+def _inception(x, n1, n3r, n3, n5r, n5, npool, name):
+    """The classic 4-branch module: 1x1 | 1x1→3x3 | 1x1→5x5 | pool→1x1."""
+    b1 = _conv(x, n1, (1, 1), name=f"{name}_b1")
+    b3 = _conv(x, n3r, (1, 1), name=f"{name}_b3r")
+    b3 = _conv(b3, n3, (3, 3), pad=(1, 1), name=f"{name}_b3")
+    b5 = _conv(x, n5r, (1, 1), name=f"{name}_b5r")
+    b5 = _conv(b5, n5, (5, 5), pad=(2, 2), name=f"{name}_b5")
+    bp = sym.Pooling(x, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                     pool_type="max", name=f"{name}_pool")
+    bp = _conv(bp, npool, (1, 1), name=f"{name}_bp")
+    return sym.Concat(b1, b3, b5, bp, dim=1, name=f"{name}_concat")
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    data = sym.Variable("data")
+    x = _conv(data, 64, (7, 7), stride=(2, 2), pad=(3, 3), name="stem1")
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = _conv(x, 64, (1, 1), name="stem2r")
+    x = _conv(x, 192, (3, 3), pad=(1, 1), name="stem2")
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = _inception(x, 64, 96, 128, 16, 32, 32, "in3a")
+    x = _inception(x, 128, 128, 192, 32, 96, 64, "in3b")
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = _inception(x, 192, 96, 208, 16, 48, 64, "in4a")
+    x = _inception(x, 160, 112, 224, 24, 64, 64, "in4b")
+    x = _inception(x, 128, 128, 256, 24, 64, 64, "in4c")
+    x = _inception(x, 112, 144, 288, 32, 64, 64, "in4d")
+    x = _inception(x, 256, 160, 320, 32, 128, 128, "in4e")
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = _inception(x, 256, 160, 320, 32, 128, 128, "in5a")
+    x = _inception(x, 384, 192, 384, 48, 128, 128, "in5b")
+    x = sym.Pooling(x, kernel=(7, 7), pool_type="avg", global_pool=True)
+    x = sym.Dropout(x, p=0.4)
+    x = sym.FullyConnected(sym.Flatten(x), num_hidden=num_classes, name="fc")
+    return sym.SoftmaxOutput(x, name="softmax")

@@ -1,0 +1,100 @@
+"""Inception-v3 (reference symbols/inception-v3.py; 299x299 input)."""
+
+from .. import symbol as sym
+
+
+def _cb(x, num_filter, kernel, stride=(1, 1), pad=(0, 0), name=None):
+    x = sym.Convolution(x, num_filter=num_filter, kernel=kernel,
+                        stride=stride, pad=pad, no_bias=True,
+                        name=f"{name}_conv")
+    x = sym.BatchNorm(x, fix_gamma=True, eps=2e-5, name=f"{name}_bn")
+    return sym.Activation(x, act_type="relu", name=f"{name}_relu")
+
+
+def _pool(x, kind, kernel=(3, 3), stride=(1, 1), pad=(1, 1)):
+    return sym.Pooling(x, kernel=kernel, stride=stride, pad=pad,
+                       pool_type=kind)
+
+
+def _inc_a(x, pool_proj, name):
+    b1 = _cb(x, 64, (1, 1), name=f"{name}_b1")
+    b2 = _cb(x, 48, (1, 1), name=f"{name}_b2a")
+    b2 = _cb(b2, 64, (5, 5), pad=(2, 2), name=f"{name}_b2b")
+    b3 = _cb(x, 64, (1, 1), name=f"{name}_b3a")
+    b3 = _cb(b3, 96, (3, 3), pad=(1, 1), name=f"{name}_b3b")
+    b3 = _cb(b3, 96, (3, 3), pad=(1, 1), name=f"{name}_b3c")
+    b4 = _cb(_pool(x, "avg"), pool_proj, (1, 1), name=f"{name}_b4")
+    return sym.Concat(b1, b2, b3, b4, dim=1)
+
+
+def _red_a(x, name):
+    b1 = _cb(x, 384, (3, 3), stride=(2, 2), name=f"{name}_b1")
+    b2 = _cb(x, 64, (1, 1), name=f"{name}_b2a")
+    b2 = _cb(b2, 96, (3, 3), pad=(1, 1), name=f"{name}_b2b")
+    b2 = _cb(b2, 96, (3, 3), stride=(2, 2), name=f"{name}_b2c")
+    b3 = _pool(x, "max", stride=(2, 2), pad=(0, 0))
+    return sym.Concat(b1, b2, b3, dim=1)
+
+
+def _inc_b(x, c7, name):
+    b1 = _cb(x, 192, (1, 1), name=f"{name}_b1")
+    b2 = _cb(x, c7, (1, 1), name=f"{name}_b2a")
+    b2 = _cb(b2, c7, (1, 7), pad=(0, 3), name=f"{name}_b2b")
+    b2 = _cb(b2, 192, (7, 1), pad=(3, 0), name=f"{name}_b2c")
+    b3 = _cb(x, c7, (1, 1), name=f"{name}_b3a")
+    b3 = _cb(b3, c7, (7, 1), pad=(3, 0), name=f"{name}_b3b")
+    b3 = _cb(b3, c7, (1, 7), pad=(0, 3), name=f"{name}_b3c")
+    b3 = _cb(b3, c7, (7, 1), pad=(3, 0), name=f"{name}_b3d")
+    b3 = _cb(b3, 192, (1, 7), pad=(0, 3), name=f"{name}_b3e")
+    b4 = _cb(_pool(x, "avg"), 192, (1, 1), name=f"{name}_b4")
+    return sym.Concat(b1, b2, b3, b4, dim=1)
+
+
+def _red_b(x, name):
+    b1 = _cb(x, 192, (1, 1), name=f"{name}_b1a")
+    b1 = _cb(b1, 320, (3, 3), stride=(2, 2), name=f"{name}_b1b")
+    b2 = _cb(x, 192, (1, 1), name=f"{name}_b2a")
+    b2 = _cb(b2, 192, (1, 7), pad=(0, 3), name=f"{name}_b2b")
+    b2 = _cb(b2, 192, (7, 1), pad=(3, 0), name=f"{name}_b2c")
+    b2 = _cb(b2, 192, (3, 3), stride=(2, 2), name=f"{name}_b2d")
+    b3 = _pool(x, "max", stride=(2, 2), pad=(0, 0))
+    return sym.Concat(b1, b2, b3, dim=1)
+
+
+def _inc_c(x, name):
+    b1 = _cb(x, 320, (1, 1), name=f"{name}_b1")
+    b2 = _cb(x, 384, (1, 1), name=f"{name}_b2a")
+    b2a = _cb(b2, 384, (1, 3), pad=(0, 1), name=f"{name}_b2b")
+    b2b = _cb(b2, 384, (3, 1), pad=(1, 0), name=f"{name}_b2c")
+    b3 = _cb(x, 448, (1, 1), name=f"{name}_b3a")
+    b3 = _cb(b3, 384, (3, 3), pad=(1, 1), name=f"{name}_b3b")
+    b3a = _cb(b3, 384, (1, 3), pad=(0, 1), name=f"{name}_b3c")
+    b3b = _cb(b3, 384, (3, 1), pad=(1, 0), name=f"{name}_b3d")
+    b4 = _cb(_pool(x, "avg"), 192, (1, 1), name=f"{name}_b4")
+    return sym.Concat(b1, b2a, b2b, b3a, b3b, b4, dim=1)
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    data = sym.Variable("data")
+    x = _cb(data, 32, (3, 3), stride=(2, 2), name="stem1")
+    x = _cb(x, 32, (3, 3), name="stem2")
+    x = _cb(x, 64, (3, 3), pad=(1, 1), name="stem3")
+    x = _pool(x, "max", stride=(2, 2), pad=(0, 0))
+    x = _cb(x, 80, (1, 1), name="stem4")
+    x = _cb(x, 192, (3, 3), name="stem5")
+    x = _pool(x, "max", stride=(2, 2), pad=(0, 0))
+    x = _inc_a(x, 32, "a1")
+    x = _inc_a(x, 64, "a2")
+    x = _inc_a(x, 64, "a3")
+    x = _red_a(x, "ra")
+    x = _inc_b(x, 128, "b1")
+    x = _inc_b(x, 160, "b2")
+    x = _inc_b(x, 160, "b3")
+    x = _inc_b(x, 192, "b4")
+    x = _red_b(x, "rb")
+    x = _inc_c(x, "c1")
+    x = _inc_c(x, "c2")
+    x = sym.Pooling(x, kernel=(8, 8), pool_type="avg", global_pool=True)
+    x = sym.Dropout(x, p=0.5)
+    x = sym.FullyConnected(sym.Flatten(x), num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(x, name="softmax")

@@ -1,0 +1,65 @@
+"""ResNeXt (reference symbols/resnext.py — grouped-conv bottlenecks;
+the 64x4d config is the reference model-zoo's 0.7911 top-1 entry)."""
+
+from .. import symbol as sym
+
+
+def _bn_relu_conv(x, num_filter, kernel, stride=(1, 1), pad=(0, 0),
+                  num_group=1, name=None):
+    x = sym.Convolution(x, num_filter=num_filter, kernel=kernel,
+                        stride=stride, pad=pad, num_group=num_group,
+                        no_bias=True, name=f"{name}_conv")
+    x = sym.BatchNorm(x, fix_gamma=False, eps=2e-5, momentum=0.9,
+                      name=f"{name}_bn")
+    return sym.Activation(x, act_type="relu", name=f"{name}_relu")
+
+
+def _block(x, num_filter, stride, dim_match, num_group, bottle_ratio, name):
+    mid = int(num_filter * bottle_ratio)
+    body = _bn_relu_conv(x, mid, (1, 1), name=f"{name}_1")
+    body = _bn_relu_conv(body, mid, (3, 3), stride=stride, pad=(1, 1),
+                         num_group=num_group, name=f"{name}_2")
+    body = sym.Convolution(body, num_filter=num_filter, kernel=(1, 1),
+                           no_bias=True, name=f"{name}_3_conv")
+    body = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=0.9,
+                         name=f"{name}_3_bn")
+    if dim_match:
+        shortcut = x
+    else:
+        shortcut = sym.Convolution(x, num_filter=num_filter, kernel=(1, 1),
+                                   stride=stride, no_bias=True,
+                                   name=f"{name}_sc_conv")
+        shortcut = sym.BatchNorm(shortcut, fix_gamma=False, eps=2e-5,
+                                 momentum=0.9, name=f"{name}_sc_bn")
+    return sym.Activation(body + shortcut, act_type="relu",
+                          name=f"{name}_out")
+
+
+def get_symbol(num_classes=1000, num_layers=50, num_group=32,
+               bottle_ratio=0.5, image_shape="3,224,224", **kwargs):
+    units = {
+        50: [3, 4, 6, 3],
+        101: [3, 4, 23, 3],
+        152: [3, 8, 36, 3],
+    }.get(num_layers)
+    if units is None:
+        raise ValueError(f"resnext: unsupported depth {num_layers}")
+    filters = [256, 512, 1024, 2048]
+
+    data = sym.Variable("data")
+    x = sym.Convolution(data, num_filter=64, kernel=(7, 7), stride=(2, 2),
+                        pad=(3, 3), no_bias=True, name="conv0")
+    x = sym.BatchNorm(x, fix_gamma=False, eps=2e-5, momentum=0.9, name="bn0")
+    x = sym.Activation(x, act_type="relu", name="relu0")
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                    pool_type="max")
+    for stage, (n, f) in enumerate(zip(units, filters)):
+        stride = (1, 1) if stage == 0 else (2, 2)
+        x = _block(x, f, stride, False, num_group, bottle_ratio,
+                   f"stage{stage + 1}_unit1")
+        for u in range(2, n + 1):
+            x = _block(x, f, (1, 1), True, num_group, bottle_ratio,
+                       f"stage{stage + 1}_unit{u}")
+    x = sym.Pooling(x, kernel=(7, 7), pool_type="avg", global_pool=True)
+    x = sym.FullyConnected(sym.Flatten(x), num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(x, name="softmax")

@@ -1,0 +1,50 @@
+"""Model-zoo symbols build, infer shapes, and run a forward pass.
+
+Covers the reference's symbol library (example/image-classification/
+symbols): alexnet, googlenet, inception-bn, inception-v3, resnet,
+resnext, vgg, mlp, lenet — each must bind and produce (batch,
+num_classes) probabilities.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+_CASES = [
+    ("mlp", lambda: models.mlp(), (2, 784)),
+    ("lenet", lambda: models.lenet(), (2, 1, 28, 28)),
+    ("alexnet", lambda: models.alexnet(num_classes=10), (2, 3, 224, 224)),
+    ("googlenet", lambda: models.googlenet(num_classes=10), (2, 3, 224, 224)),
+    ("inception-bn", lambda: models.inception_bn(num_classes=10),
+     (2, 3, 224, 224)),
+    ("inception-v3", lambda: models.inception_v3(num_classes=10),
+     (2, 3, 299, 299)),
+    ("resnet-18", lambda: models.resnet(num_classes=10, num_layers=18),
+     (2, 3, 224, 224)),
+    ("resnext-50", lambda: models.resnext(num_classes=10, num_layers=50),
+     (2, 3, 224, 224)),
+    ("vgg-16", lambda: models.vgg(num_classes=10), (2, 3, 224, 224)),
+]
+
+
+@pytest.mark.parametrize("name,factory,dshape", _CASES,
+                         ids=[c[0] for c in _CASES])
+def test_model_builds_and_forwards(name, factory, dshape):
+    net = factory()
+    exe = net.simple_bind(mx.cpu(), data=dshape,
+                          softmax_label=(dshape[0],))
+    rng = np.random.RandomState(0)
+    for n, arr in exe.arg_dict.items():
+        if n not in ("data", "softmax_label"):
+            arr[:] = mx.nd.array(
+                rng.uniform(-0.05, 0.05, arr.shape).astype(np.float32)
+            )
+    exe.arg_dict["data"][:] = mx.nd.array(
+        rng.rand(*dshape).astype(np.float32)
+    )
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (dshape[0], 10)
+    assert np.allclose(out.sum(axis=1), 1.0, atol=1e-3), "not a softmax"
+    assert np.isfinite(out).all()
