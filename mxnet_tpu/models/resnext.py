@@ -36,7 +36,9 @@ def _block(x, num_filter, stride, dim_match, num_group, bottle_ratio, name):
 
 
 def get_symbol(num_classes=1000, num_layers=50, num_group=32,
-               bottle_ratio=0.5, image_shape="3,224,224", **kwargs):
+               bottle_ratio=0.5, **kwargs):
+    # (no image_shape param: this builder is the ImageNet variant only —
+    # passing a small-image shape would silently get the 7x7/s2 stem)
     units = {
         50: [3, 4, 6, 3],
         101: [3, 4, 23, 3],

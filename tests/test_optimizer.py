@@ -84,7 +84,9 @@ def test_lr_scheduler_factor():
     w = mx.nd.zeros((1,))
     for i in range(25):
         opt.update(0, w, mx.nd.ones((1,)), None)
-    assert sched.base_lr == 0.25  # two decays
+    # after 25 updates two decays have fired (derived from num_update;
+    # base_lr itself stays the initial lr)
+    assert sched(opt.num_update) == 0.25
 
 
 def test_lr_wd_mult():
