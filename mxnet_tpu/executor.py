@@ -86,12 +86,20 @@ class _CompiledGraph:
                 serial += 1
         self.num_rng_ops = serial
 
-    def evaluate(self, arg_vals, aux_vals, rng, is_train, monitor=None):
-        """Run the graph. Returns (head_outputs, aux_updates_list)."""
+    def evaluate(self, arg_vals, aux_vals, rng, is_train, monitor=None,
+                 limit=None):
+        """Run the graph. Returns (head_outputs, aux_updates_list).
+
+        With ``limit`` set, interprets only the first ``limit`` op nodes and
+        returns that prefix's last outputs instead of the heads — the
+        PartialForward debug contract (one interpreter serves both paths so
+        placement/remat/rng handling can never diverge)."""
         import jax
 
         env = {}
         aux_updates = list(aux_vals)
+        executed = 0
+        last_outs = []
         for node in self.topo:
             if node.is_variable:
                 if node.is_aux:
@@ -99,6 +107,8 @@ class _CompiledGraph:
                 else:
                     env[id(node)] = [arg_vals[self._arg_index[node.name]]]
                 continue
+            if limit is not None and executed >= limit:
+                break
             params = node.params()
             ins = [env[id(inode)][idx] for (inode, idx) in node.inputs]
             dev = self.node2dev.get(id(node))
@@ -123,6 +133,8 @@ class _CompiledGraph:
                     ins, params, OpMode(is_train=is_train, rng=node_rng)
                 )
             env[id(node)] = outs
+            last_outs = outs
+            executed += 1
             if new_aux:
                 n_args = len(node.op.arg_names(params))
                 for i, na in enumerate(new_aux):
@@ -132,6 +144,8 @@ class _CompiledGraph:
                 for i, o in enumerate(outs[: node.op.num_visible_outputs(params)]):
                     suffix = "_output" if i == 0 else f"_output{i}"
                     monitor(node.name + suffix, o)
+        if limit is not None:
+            return last_outs, aux_updates
         head_outs = [env[id(node)][idx] for (node, idx) in self.heads]
         return head_outs, aux_updates
 
@@ -681,6 +695,57 @@ class Executor:
         return jax.tree_util.tree_unflatten(state_td, new_leaves)
 
     # ------------------------------------------------------------------
+    def debug_str(self):
+        """Human-readable execution plan (reference ``Executor::DebugStr``:
+        the graph_executor prints its node schedule + memory plan; here the
+        plan is the topo order handed to XLA, with placement when ctx
+        groups are active)."""
+        lines = [f"Symbol outputs: {', '.join(self.output_names)}",
+                 f"ctx: {self._ctx}  mode: "
+                 + ("interpret(NaiveEngine)" if self._naive else
+                    "interpret(placed)" if self._node2dev else "jit")]
+        for i, node in enumerate(self.graph.topo):
+            if node.is_variable:
+                kind = "aux" if node.is_aux else "var"
+                lines.append(f"  [{i:3d}] {kind:8s} {node.name}")
+                continue
+            dev = self._node2dev.get(id(node))
+            where = f" @{dev}" if dev is not None else ""
+            lines.append(f"  [{i:3d}] {node.op.name:20s} {node.name}{where}")
+        lines.append(f"Total {len(self.graph.topo)} nodes "
+                     f"({len(self.arg_names)} args, "
+                     f"{len(self.aux_names)} aux)")
+        return "\n".join(lines)
+
+    def partial_forward(self, is_train=False, num_nodes=None, **kwargs):
+        """Run the forward graph up to ``num_nodes`` op nodes in interpret
+        mode and return that prefix's last outputs as NDArrays (reference
+        ``PartialForward``, graph_executor.cc:61 — step-wise execution for
+        debugging; always un-fused like the monitor path). kwargs bind new
+        input values with the same validation as ``forward``."""
+        import jax
+
+        for name, arr in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError(f"partial_forward: unknown argument {name!r}")
+            tgt = self.arg_dict[name]
+            src = arr._data if isinstance(arr, NDArray) else jax.numpy.asarray(arr)
+            if tuple(src.shape) != tgt.shape:
+                raise MXNetError(
+                    f"partial_forward: shape mismatch for {name}: bound "
+                    f"{tgt.shape}, got {tuple(src.shape)}"
+                )
+            tgt._data = src.astype(tgt.dtype)
+        rng = self._rng_key()
+        key = jax.random.fold_in(rng[0], int(rng[1]))
+        if num_nodes is None:
+            num_nodes = len(self.graph.topo)  # run everything, last outputs
+        outs, _aux = self.graph.evaluate(
+            self._arg_vals(), self._aux_vals(), key, is_train,
+            limit=num_nodes,
+        )
+        return [NDArray(o) for o in outs]
+
     def set_monitor_callback(self, callback, monitor_all=False):
         """Install a per-op-output stat callback → interpret mode.
 

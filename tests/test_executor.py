@@ -110,3 +110,31 @@ def test_multi_output_executor():
     exe.forward(x=mx.nd.array([[1, 2, 3, 4], [5, 6, 7, 8]]))
     assert_almost_equal(exe.outputs[0].asnumpy(), [[2, 4], [10, 12]])
     assert_almost_equal(exe.outputs[1].asnumpy(), [[4, 5], [8, 9]])
+
+
+def test_debug_str_and_partial_forward():
+    """Executor introspection (reference DebugStr + PartialForward)."""
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc1"),
+        act_type="relu", name="act1",
+    )
+    net = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+    exe = net.simple_bind(mx.cpu(), data=(3, 5))
+    plan = exe.debug_str()
+    assert "fc1" in plan and "fc2" in plan and "FullyConnected" in plan
+    assert "Total" in plan
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 5).astype(np.float32)
+    w1 = rng.randn(4, 5).astype(np.float32)
+    exe.arg_dict["fc1_weight"][:] = mx.nd.array(w1)
+    exe.arg_dict["fc1_bias"][:] = mx.nd.zeros((4,))
+    # first op node only: the fc1 pre-activation
+    outs = exe.partial_forward(num_nodes=1, data=mx.nd.array(x))
+    np.testing.assert_allclose(outs[0].asnumpy(), x.dot(w1.T), rtol=1e-5)
+    # two nodes: relu applied
+    outs = exe.partial_forward(num_nodes=2, data=mx.nd.array(x))
+    np.testing.assert_allclose(
+        outs[0].asnumpy(), np.maximum(x.dot(w1.T), 0), rtol=1e-5
+    )
