@@ -426,24 +426,29 @@ class Executor:
         return core
 
     # ------------------------------------------------------------------
-    def forward(self, is_train=False, **kwargs):
-        """Bind new input values and schedule a forward pass (lazy)."""
+    def _bind_inputs(self, kwargs, what):
+        """Validate + write new input values into arg_dict (shared by
+        forward and partial_forward so validation/sharding can't diverge)."""
         import jax
 
         for name, arr in kwargs.items():
             if name not in self.arg_dict:
-                raise MXNetError(f"forward: unknown argument {name!r}")
+                raise MXNetError(f"{what}: unknown argument {name!r}")
             tgt = self.arg_dict[name]
             src = arr._data if isinstance(arr, NDArray) else jax.numpy.asarray(arr)
             if tuple(src.shape) != tgt.shape:
                 raise MXNetError(
-                    f"forward: shape mismatch for {name}: bound {tgt.shape}, "
+                    f"{what}: shape mismatch for {name}: bound {tgt.shape}, "
                     f"got {tuple(src.shape)}"
                 )
             src = src.astype(tgt.dtype)
             if name in self._in_shardings:
                 src = jax.device_put(src, self._in_shardings[name])
             tgt._data = src
+
+    def forward(self, is_train=False, **kwargs):
+        """Bind new input values and schedule a forward pass (lazy)."""
+        self._bind_inputs(kwargs, "forward")
         # engine write-ordering: a still-scheduled backward must land its
         # grad/aux/output writes before this newer forward supersedes them
         # (in the steady train loop update() has already consumed it)
@@ -704,15 +709,17 @@ class Executor:
                  f"ctx: {self._ctx}  mode: "
                  + ("interpret(NaiveEngine)" if self._naive else
                     "interpret(placed)" if self._node2dev else "jit")]
-        for i, node in enumerate(self.graph.topo):
+        step = 0  # op-node ordinal — the unit partial_forward(num_nodes=k) counts
+        for node in self.graph.topo:
             if node.is_variable:
                 kind = "aux" if node.is_aux else "var"
-                lines.append(f"  [{i:3d}] {kind:8s} {node.name}")
+                lines.append(f"  [     ] {kind:8s} {node.name}")
                 continue
+            step += 1
             dev = self._node2dev.get(id(node))
             where = f" @{dev}" if dev is not None else ""
-            lines.append(f"  [{i:3d}] {node.op.name:20s} {node.name}{where}")
-        lines.append(f"Total {len(self.graph.topo)} nodes "
+            lines.append(f"  [{step:4d} ] {node.op.name:20s} {node.name}{where}")
+        lines.append(f"Total {step} op nodes "
                      f"({len(self.arg_names)} args, "
                      f"{len(self.aux_names)} aux)")
         return "\n".join(lines)
@@ -722,22 +729,10 @@ class Executor:
         mode and return that prefix's last outputs as NDArrays (reference
         ``PartialForward``, graph_executor.cc:61 — step-wise execution for
         debugging; always un-fused like the monitor path). kwargs bind new
-        input values with the same validation as ``forward``."""
-        import jax
-
-        for name, arr in kwargs.items():
-            if name not in self.arg_dict:
-                raise MXNetError(f"partial_forward: unknown argument {name!r}")
-            tgt = self.arg_dict[name]
-            src = arr._data if isinstance(arr, NDArray) else jax.numpy.asarray(arr)
-            if tuple(src.shape) != tgt.shape:
-                raise MXNetError(
-                    f"partial_forward: shape mismatch for {name}: bound "
-                    f"{tgt.shape}, got {tuple(src.shape)}"
-                )
-            tgt._data = src.astype(tgt.dtype)
-        rng = self._rng_key()
-        key = jax.random.fold_in(rng[0], int(rng[1]))
+        input values through the same binder as ``forward``. ``num_nodes``
+        counts OP nodes — the ``step`` ordinals debug_str prints."""
+        self._bind_inputs(kwargs, "partial_forward")
+        key = _fold_rng(self._rng_key())
         if num_nodes is None:
             num_nodes = len(self.graph.topo)  # run everything, last outputs
         outs, _aux = self.graph.evaluate(

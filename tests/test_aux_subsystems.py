@@ -267,6 +267,37 @@ def test_monitor_collects_stats():
 
 
 # --------------------------------------------------------------------------
+# callbacks
+# --------------------------------------------------------------------------
+def test_speedometer_logs_rate(caplog):
+    import logging as _logging
+    from collections import namedtuple
+
+    P = namedtuple("P", ["epoch", "nbatch", "eval_metric"])
+    spd = mx.callback.Speedometer(batch_size=32, frequent=2)
+    with caplog.at_level(_logging.INFO):
+        for nb in range(1, 7):
+            spd(P(0, nb, None))
+    msgs = [r.message for r in caplog.records if "Speed" in r.message]
+    # fires at nbatch 2 (arms), 4, 6 → two rate logs
+    assert len(msgs) == 2
+    assert "samples/sec" in msgs[0]
+
+
+def test_checkpoint_callbacks_fire_on_period(tmp_path):
+    fired = []
+
+    class FakeMod:
+        def save_checkpoint(self, prefix, epoch, states=False):
+            fired.append(epoch)
+
+    cb = mx.callback.module_checkpoint(FakeMod(), str(tmp_path / "p"), period=2)
+    for ep in range(4):
+        cb(ep)
+    assert fired == [2, 4]
+
+
+# --------------------------------------------------------------------------
 # predictor + FeedForward + visualization
 # --------------------------------------------------------------------------
 def test_predictor_api(tmp_path):
