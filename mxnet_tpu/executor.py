@@ -38,13 +38,10 @@ from .ops.registry import OpMode
 
 _GRAD_REQ = ("write", "add", "null")
 
-# ops whose FGradient drives backward without an explicit head gradient
-# (reference loss layers: their backward ignores out_grad)
-_LOSS_OPS = {
-    "SoftmaxOutput", "MakeLoss", "LinearRegressionOutput",
-    "LogisticRegressionOutput", "MAERegressionOutput", "SVMOutput",
-    "make_loss", "Softmax",
-}
+# Loss heads (backward ignores out_grad) are detected from the op
+# definition's ``is_loss`` flag, set where the loss layers register
+# (ops/defs_nn.py) — not from a name list, so new/custom loss ops that
+# set the flag participate in implicit head gradients.
 
 
 def _fold_rng(rng):
@@ -377,7 +374,7 @@ class Executor:
         # non-loss heads contribute ZERO — the reference executor doesn't
         # inject gradients for extra outputs like Group(loss, features)
         head_is_loss = [
-            not node.is_variable and node.op.name in _LOSS_OPS
+            not node.is_variable and getattr(node.op, "is_loss", False)
             for (node, _ix) in graph.heads
         ]
         if not any(head_is_loss):
@@ -532,6 +529,24 @@ class Executor:
             raise MXNetError("backward called before forward")
         if out_grads is not None and not isinstance(out_grads, (list, tuple)):
             out_grads = [out_grads]
+        if out_grads is None:
+            # variable heads count as non-loss: they too contribute zero
+            # gradient without an explicit head grad
+            flags = [
+                not node.is_variable and getattr(node.op, "is_loss", False)
+                for (node, _ix) in self.graph.heads
+            ]
+            if any(flags) and not all(flags):
+                import warnings
+
+                warnings.warn(
+                    "backward() without out_grads on a Group mixing loss "
+                    "and non-loss outputs: the non-loss heads contribute "
+                    "ZERO gradient (pass explicit out_grads, or register "
+                    "the op with is_loss=True if its backward ignores the "
+                    "head gradient)",
+                    stacklevel=2,
+                )
         head_grads = None
         if out_grads is not None:
             head_grads = [

@@ -194,13 +194,16 @@ class KVStore:
         ps-lite scheduler liveness, kvstore_dist.h:177-185).
 
         In this architecture liveness detection lives in the LAUNCHER:
-        ``tools/launch.py`` supervises ranks, restarts failures
-        (``--max-restarts``) and fails the job when the budget is spent —
-        a worker that can run this call is, by construction of the SPMD
-        collectives, in a job whose members are all alive (a dead peer
-        stalls the next collective rather than silently dropping out).
-        Hence 0 from inside a healthy worker."""
-        return 0
+        ``tools/launch.py`` supervises ranks and restarts the whole job on
+        any rank death (``--max-restarts``) — a worker that can run this
+        call is, by construction of the SPMD collectives, in a job whose
+        members are all alive (a dead peer stalls the next collective
+        rather than silently dropping out). What the launcher DOES surface
+        is how many node deaths the job has recovered from: the
+        MXNET_NUM_RESTARTS env it sets on every (re)launch."""
+        import os
+
+        return int(os.environ.get("MXNET_NUM_RESTARTS", "0"))
 
 
 class DistKVStore(KVStore):

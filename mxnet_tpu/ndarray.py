@@ -643,14 +643,28 @@ def _np_of(arr):
 
 
 def _write_shape(f, shape):
-    # nnvm::Tuple::Save: uint32 ndim + uint32 dims
+    # nnvm::Tuple::Save: uint32 ndim + int64 dims (nnvm dim_t = int64_t;
+    # the reference's "version 1, with int64_t TShape" comment at
+    # ndarray.cc:800 — only the V0 magic-is-ndim legacy path is uint32)
     f.write(struct.pack("<I", len(shape)))
-    f.write(struct.pack(f"<{len(shape)}I", *shape))
+    f.write(struct.pack(f"<{len(shape)}q", *shape))
 
 
 def _read_shape(f):
     (ndim,) = struct.unpack("<I", f.read(4))
-    return struct.unpack(f"<{ndim}I", f.read(4 * ndim)) if ndim else ()
+    if not ndim:
+        return ()
+    dims = struct.unpack(f"<{ndim}q", f.read(8 * ndim))
+    # a pre-r3 file's uint32 dim pair merges into one int64 >= 2^32 (the
+    # high word is a dim >= 1), so this bound catches old files on the
+    # very first shape read
+    if any(d < 0 or d >= (1 << 32) for d in dims):
+        raise MXNetError(
+            "corrupt TShape while loading .params (dims read as int64 per "
+            "the reference format); files written by pre-r3 builds of this "
+            "framework used uint32 dims and must be re-saved"
+        )
+    return tuple(int(d) for d in dims)
 
 
 def _dtype_np(buf, dtype_name, shape):

@@ -702,6 +702,7 @@ register(
     },
     fill_in_shapes=_softmax_output_fill,
     aliases=("Softmax",),
+    is_loss=True,
 )
 
 
@@ -742,6 +743,7 @@ register(
         "normalization": Param(parse_str, "null"),
     },
     aliases=("make_loss",),
+    is_loss=True,
 )
 
 
@@ -778,6 +780,7 @@ register(
     arg_names=["data", "label"],
     param_schema=dict(_REG_SCHEMA),
     fill_in_shapes=lambda shapes, p: [shapes[0], shapes[1] or shapes[0]],
+    is_loss=True,
 )
 
 register(
@@ -786,6 +789,7 @@ register(
     arg_names=["data", "label"],
     param_schema=dict(_REG_SCHEMA),
     fill_in_shapes=lambda shapes, p: [shapes[0], shapes[1] or shapes[0]],
+    is_loss=True,
 )
 
 register(
@@ -794,6 +798,7 @@ register(
     arg_names=["data", "label"],
     param_schema=dict(_REG_SCHEMA),
     fill_in_shapes=lambda shapes, p: [shapes[0], shapes[1] or shapes[0]],
+    is_loss=True,
 )
 
 
@@ -842,6 +847,7 @@ register(
         shapes[0],
         shapes[1] or ((shapes[0][0],) if shapes[0] else None),
     ],
+    is_loss=True,
 )
 
 

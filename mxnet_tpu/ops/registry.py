@@ -87,6 +87,7 @@ class OpDef:
         need_rng: bool = False,
         aliases: Sequence[str] = (),
         mutate: Sequence = (),
+        is_loss: bool = False,
         doc: str = "",
     ):
         self.name = name
@@ -104,6 +105,10 @@ class OpDef:
         # rebind these input handles to the given outputs (the analogue of
         # the reference's mutable-input declaration on optimizer ops).
         self.mutate = tuple(mutate)
+        # loss layers: backward ignores the head gradient (the reference's
+        # convention for SoftmaxOutput/MakeLoss/...); drives the implicit
+        # head-grad decision in executor.backward() instead of a name list
+        self.is_loss = bool(is_loss)
         self.doc = doc
 
     # --- introspection ---------------------------------------------------

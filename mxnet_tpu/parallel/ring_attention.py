@@ -37,10 +37,13 @@ def _ring_attn_shard(q, k, v, axis_name, causal, scale):
     B, H, Tl, D = q.shape
     qf = q.astype(jnp.float32) * scale
 
-    # pvary: accumulators are per-device state (varying over the ring axis)
-    o = jax.lax.pvary(jnp.zeros((B, H, Tl, D), jnp.float32), axis_name)
-    m = jax.lax.pvary(jnp.full((B, H, Tl), -jnp.inf, jnp.float32), axis_name)
-    l = jax.lax.pvary(jnp.zeros((B, H, Tl), jnp.float32), axis_name)
+    # accumulators are per-device state (varying over the ring axis)
+    def _vary(x):
+        return jax.lax.pcast(x, axis_name, to="varying")
+
+    o = _vary(jnp.zeros((B, H, Tl, D), jnp.float32))
+    m = _vary(jnp.full((B, H, Tl), -jnp.inf, jnp.float32))
+    l = _vary(jnp.zeros((B, H, Tl), jnp.float32))
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(i, carry):

@@ -265,6 +265,20 @@ class ImageRecordIter:
 
         if use_native is None:
             use_native = self.data_shape[0] == 3 and _native.available()
+            if use_native:
+                # the native plane decodes JPEG only — sniff the first
+                # record's magic bytes so .rec files holding PNG/other
+                # formats keep flowing through the python/cv2 path instead
+                # of erroring mid-epoch at the first batch
+                rec = MXRecordIO(path_imgrec, "r")
+                try:
+                    buf = rec.read()
+                finally:
+                    rec.close()
+                if buf is not None:
+                    _, payload = unpack(buf)
+                    if payload[:2] != b"\xff\xd8":
+                        use_native = False
         elif use_native:
             # explicit request must not silently degrade to the python path
             if not _native.available():

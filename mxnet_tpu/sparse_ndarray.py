@@ -196,9 +196,9 @@ class BaseSparseNDArray(NDArray):
         if isinstance(value, BaseSparseNDArray):
             value.copyto(self)
         elif isinstance(value, NDArray):
-            self._set_from_dense(value._data)
+            self._data = value._data  # property setter clears _d/_lazy caches
         elif isinstance(value, (np.ndarray, np.generic)):
-            self._set_from_dense(_asjax(np.asarray(value, dtype=self.dtype)))
+            self._data = _asjax(np.asarray(value, dtype=self.dtype))
         else:
             raise MXNetError(f"cannot assign type {type(value)} to SparseNDArray")
 

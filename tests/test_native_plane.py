@@ -218,3 +218,23 @@ def test_c_predict_abi_end_to_end(tmp_path):
     mod.forward(mx.io.DataBatch([mx.nd.array(x)], []), is_train=False)
     expect = mod.get_outputs()[0].asnumpy().ravel()
     assert_almost_equal(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_png_rec_falls_back_to_python_plane(tmp_path):
+    """Auto-selection sniffs image magic: PNG payloads (which the native
+    JPEG decoder can't handle) route to the cv2 path instead of erroring
+    mid-epoch."""
+    path = str(tmp_path / "png.rec")
+    rng = np.random.RandomState(0)
+    rec = MXRecordIO(path, "w")
+    for i in range(4):
+        img = rng.randint(0, 255, (32, 32, 3), np.uint8)
+        rec.write(pack_img((0, float(i), i, 0), img, img_fmt=".png"))
+    rec.close()
+    it = mx.io.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 32, 32), batch_size=2,
+    )
+    assert not it._native, "PNG rec must not select the native JPEG plane"
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (2, 3, 32, 32)

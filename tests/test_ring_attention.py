@@ -7,6 +7,10 @@ import mxnet_tpu as mx
 from mxnet_tpu.parallel.ring_attention import _full_attention, ring_attention
 from mxnet_tpu.test_utils import assert_almost_equal
 
+# CI-style API-rot guard: any deprecated jax API used by the parallel
+# package fails these tests instead of warning (VERDICT r2 item 7)
+pytestmark = pytest.mark.filterwarnings("error::DeprecationWarning")
+
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_matches_full(causal):

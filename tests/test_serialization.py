@@ -26,7 +26,7 @@ def test_dense_golden_bytes(tmp_path):
         struct.pack("<Q", 1),                  # ndarray count
         struct.pack("<I", 0xF993FAC9),         # NDARRAY_V2_MAGIC
         struct.pack("<i", 0),                  # stype kDefaultStorage
-        struct.pack("<I", 2), struct.pack("<II", 1, 2),  # TShape (1,2)
+        struct.pack("<I", 2), struct.pack("<qq", 1, 2),  # TShape (1,2), int64 dims
         struct.pack("<ii", 1, 0),              # Context kCPU dev 0
         struct.pack("<i", 0),                  # mshadow kFloat32
         np.array([[1.0, 2.0]], np.float32).tobytes(),
@@ -42,29 +42,37 @@ def test_reference_written_file_loads(tmp_path):
     vals = np.arange(6, dtype=np.float32).reshape(2, 3)
     with open(fname, "wb") as f:
         f.write(struct.pack("<QQ", 0x112, 0))
-        f.write(struct.pack("<Q", 2))
+        f.write(struct.pack("<Q", 3))
         # array 0: V2 dense fp32
         f.write(struct.pack("<I", 0xF993FAC9))
         f.write(struct.pack("<i", 0))
-        f.write(struct.pack("<I", 2) + struct.pack("<II", 2, 3))
+        f.write(struct.pack("<I", 2) + struct.pack("<qq", 2, 3))
         f.write(struct.pack("<ii", 1, 0))
         f.write(struct.pack("<i", 0))
         f.write(vals.tobytes())
         # array 1: legacy V1 dense int32
         f.write(struct.pack("<I", 0xF993FAC8))
-        f.write(struct.pack("<I", 1) + struct.pack("<I", 4))
+        f.write(struct.pack("<I", 1) + struct.pack("<q", 4))
         f.write(struct.pack("<ii", 2, 0))      # a GPU context in the file
         f.write(struct.pack("<i", 4))          # kInt32
         f.write(np.array([7, 8, 9, 10], np.int32).tobytes())
-        f.write(struct.pack("<Q", 2))
-        for n in (b"arg:weight", b"aux:mean"):
+        # array 2: legacy V0 dense fp32 (magic word IS ndim, uint32 dims)
+        f.write(struct.pack("<I", 2))          # ndim=2 doubles as "magic"
+        f.write(struct.pack("<II", 2, 2))
+        f.write(struct.pack("<ii", 1, 0))
+        f.write(struct.pack("<i", 0))
+        f.write(np.array([[1, 2], [3, 4]], np.float32).tobytes())
+        f.write(struct.pack("<Q", 3))
+        for n in (b"arg:weight", b"aux:mean", b"arg:v0"):
             f.write(struct.pack("<Q", len(n)) + n)
     loaded = mx.nd.load(fname)
-    assert set(loaded) == {"arg:weight", "aux:mean"}
+    assert set(loaded) == {"arg:weight", "aux:mean", "arg:v0"}
     assert_almost_equal(loaded["arg:weight"].asnumpy(), vals)
     got = loaded["aux:mean"].asnumpy()
     assert got.dtype == np.int32
     assert_almost_equal(got, [7, 8, 9, 10])
+    assert_almost_equal(loaded["arg:v0"].asnumpy(),
+                        np.array([[1, 2], [3, 4]], np.float32))
 
 
 def test_roundtrip_dtypes(tmp_path):

@@ -346,3 +346,19 @@ def test_sparse_embedding_grad_pattern():
     expect[1] -= 2.0  # index 1 looked up twice, d(sum)/d(row) = count
     expect[6] -= 1.0
     assert_almost_equal(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_setitem_after_densify_clears_cache():
+    """Regression: '[:] = dense' must invalidate the cached dense buffer
+    created by an earlier todense()/asnumpy() read."""
+    a = sp.row_sparse(np.ones((1, 3), np.float32), np.array([0], np.int32),
+                      (2, 3))
+    _ = a.asnumpy()                     # populate the dense cache
+    new = np.eye(2, 3, dtype=np.float32)
+    a[:] = new
+    assert_almost_equal(a.todense().asnumpy(), new)
+    assert_almost_equal(a.asnumpy(), new)
+    # same through the NDArray branch
+    _ = a.asnumpy()
+    a[:] = mx.nd.zeros((2, 3))
+    assert_almost_equal(a.asnumpy(), np.zeros((2, 3), np.float32))

@@ -22,12 +22,15 @@ import subprocess
 import sys
 
 
-def _worker_env(rank, num_workers, coordinator):
+def _worker_env(rank, num_workers, coordinator, num_restarts=0):
     env = dict(os.environ)
     env.update({
         "MXNET_COORDINATOR": coordinator,
         "MXNET_NUM_PROCS": str(num_workers),
         "MXNET_PROC_ID": str(rank),
+        # how many times the supervisor has restarted the job — surfaced
+        # to workers so kvstore.num_dead_node can report reality
+        "MXNET_NUM_RESTARTS": str(num_restarts),
         # reference-compatible names some scripts read:
         "DMLC_NUM_WORKER": str(num_workers),
         "DMLC_WORKER_ID": str(rank),
@@ -36,54 +39,69 @@ def _worker_env(rank, num_workers, coordinator):
 
 
 def _supervise_local(command, num_workers, coordinator, max_restarts):
-    """Run + monitor local workers; restart failed ranks (the launcher-level
-    failure detection the reference gets from the ps-lite scheduler's
-    liveness tracking + is_recovery restart path, kvstore_dist.h:177-195).
+    """Run + monitor local workers; restart the JOB on any rank failure
+    (the launcher-level failure detection the reference gets from the
+    ps-lite scheduler's liveness tracking + is_recovery restart path,
+    kvstore_dist.h:177-195).
 
-    A worker that exits non-zero is relaunched with the same rank env, up
-    to ``max_restarts`` times per rank. NOTE: a restarted rank only re-syncs
-    state because every rank runs the same program from its own entry —
-    scripts that need mid-training recovery must checkpoint/resume
-    (--load-epoch pattern); the launcher guarantees detection + relaunch.
+    Restarts are whole-job: the jax distributed runtime cannot re-admit a
+    single restarted rank while the surviving ranks sit stalled in a
+    collective (and if rank 0 dies, the coordination service dies with it),
+    so a per-rank restart would deadlock until timeout. Instead any
+    non-zero exit terminates every rank and relaunches all of them, up to
+    ``max_restarts`` times; mid-training progress survives via the scripts'
+    own checkpoint/resume (--load-epoch pattern). Each attempt advances the
+    coordinator port (stale-socket avoidance) and exports
+    MXNET_NUM_RESTARTS so workers can report the recovery count.
     """
     import time
 
-    procs = {}
-    restarts = {r: 0 for r in range(num_workers)}
-    for rank in range(num_workers):
-        procs[rank] = subprocess.Popen(
-            command, env=_worker_env(rank, num_workers, coordinator)
-        )
-    failed = False
-    while procs:
-        time.sleep(0.2)
-        for rank, p in list(procs.items()):
-            rc = p.poll()
-            if rc is None:
-                continue
-            del procs[rank]
-            if rc == 0:
-                continue
-            if restarts[rank] < max_restarts:
-                restarts[rank] += 1
-                sys.stderr.write(
-                    f"launch.py: rank {rank} died (rc={rc}); restart "
-                    f"{restarts[rank]}/{max_restarts}\n"
-                )
-                procs[rank] = subprocess.Popen(
-                    command, env=_worker_env(rank, num_workers, coordinator)
-                )
-            else:
-                sys.stderr.write(
-                    f"launch.py: rank {rank} dead (rc={rc}), no restarts "
-                    "left — terminating the job\n"
-                )
-                failed = True
+    host, port0 = coordinator.rsplit(":", 1)
+    attempt = 0
+    while True:
+        coord = f"{host}:{int(port0) + attempt}"
+        procs = {
+            rank: subprocess.Popen(
+                command,
+                env=_worker_env(rank, num_workers, coord, attempt),
+            )
+            for rank in range(num_workers)
+        }
+        failed_rank = None
+        while procs:
+            time.sleep(0.2)
+            for rank, p in list(procs.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del procs[rank]
+                if rc == 0:
+                    continue
+                failed_rank = (rank, rc)
                 for q in procs.values():
                     q.terminate()
+                for q in procs.values():
+                    try:
+                        q.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        q.kill()
+                        q.wait()
                 procs.clear()
                 break
-    return 1 if failed else 0
+        if failed_rank is None:
+            return 0
+        rank, rc = failed_rank
+        if attempt >= max_restarts:
+            sys.stderr.write(
+                f"launch.py: rank {rank} died (rc={rc}), restart budget "
+                f"spent ({max_restarts}) — job failed\n"
+            )
+            return 1
+        attempt += 1
+        sys.stderr.write(
+            f"launch.py: rank {rank} died (rc={rc}); whole-job restart "
+            f"{attempt}/{max_restarts}\n"
+        )
 
 
 def main():
@@ -94,7 +112,8 @@ def main():
                         choices=["local", "ssh"])
     parser.add_argument("--port", type=int, default=9127)
     parser.add_argument("--max-restarts", type=int, default=0,
-                        help="restarts per failed rank (local launcher)")
+                        help="whole-job restarts after any rank failure "
+                             "(local launcher)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.command:
