@@ -52,6 +52,26 @@ def _fold_rng(rng):
     return jax.random.fold_in(base, step)
 
 
+def _head_loss_flags(graph):
+    """Which graph heads are loss outputs (drive an implicit backward).
+
+    Variable heads count as non-loss: they too contribute zero gradient
+    without an explicit head grad. Single source of truth for backward()'s
+    misuse warning and _make_grad_core's gradient construction.
+    """
+    return [
+        not node.is_variable and getattr(node.op, "is_loss", False)
+        for (node, _ix) in graph.heads
+    ]
+
+
+def _next_step(rng):
+    """Next step counter, computed inside the same program that consumes the
+    rng — a separate increment dispatch (or a fresh numpy scalar per call)
+    costs a full per-execute overhead on tunneled runtimes."""
+    return rng[1] + np.uint32(1)
+
+
 class _CompiledGraph:
     """The symbol lowered to a pure function over ordered value lists.
 
@@ -204,6 +224,8 @@ class Executor:
         self._pending = None  # None | 'train' | 'eval'
         self._fresh = False
         self._step = 0
+        self._step_dev = None  # device-resident mirror of _step (see _rng_key)
+        self._step_dev_val = -1
         import jax
 
         # executor rng chain derives from the GLOBAL seed at bind time, so
@@ -309,15 +331,29 @@ class Executor:
         return [self.aux_dict[n]._data for n in self.aux_names]
 
     def _rng_key(self):
-        """Per-step rng as a (base_key, step) pair.
+        """Per-step rng as a (base_key, step) pair of DEVICE values.
 
-        The fold happens INSIDE the jitted program (``_fold_rng``): the base
-        key is a device-resident constant (transferred once) and the step a
-        tiny scalar marshalled with the call, so advancing the rng costs no
-        extra device dispatch — a host-side ``fold_in`` here was a full
-        round-trip per training step on tunneled runtimes.
+        The fold happens INSIDE the jitted program (``_fold_rng``); both the
+        base key and the step counter live on the device. Marshalling even a
+        single fresh numpy scalar with each execute costs a blocking
+        host->device round trip on tunneled runtimes (measured ~2ms each,
+        and it stalls the execute pipeline), so the step advances via an
+        all-device increment program and is uploaded only when the host
+        counter diverges (first use / checkpoint restore).
         """
-        return (self._base_key, np.uint32(self._step))
+        import jax
+
+        if self._step_dev is None or self._step_dev_val != self._step:
+            self._step_dev = jax.device_put(np.uint32(self._step))
+            self._step_dev_val = self._step
+        return (self._base_key, self._step_dev)
+
+    def _accept_next_step(self, next_step, scheduled_val):
+        """Adopt the step counter a program returned (= scheduled_val + 1),
+        keeping the device mirror warm so steady-state training/inference
+        loops never re-upload it."""
+        self._step_dev = next_step
+        self._step_dev_val = scheduled_val + 1
 
     def _get_jit(self, kind, is_train=False, with_head_grads=False):
         """Build (lazily) the jitted program for this graph shape-signature."""
@@ -343,16 +379,23 @@ class Executor:
                 outs, aux_upd = graph.evaluate(
                     arg_vals, aux_vals, _fold_rng(rng), is_train
                 )
-                return outs, aux_upd
+                return outs, aux_upd, _next_step(rng)
 
             fn = _fwd if (self._node2dev or self._naive) else jax.jit(_fwd)
         elif kind == "train_step":
             core = self._make_grad_core()
+
+            def _tstep(arg_vals, aux_vals, rng, heads, prev):
+                outs, aux_upd, grad_map = core(
+                    arg_vals, aux_vals, rng, heads, prev
+                )
+                return outs, aux_upd, grad_map, _next_step(rng)
+
             # ctx-group placement spans devices: XLA compiles single-device
             # (or SPMD-sharded) programs only, so a placed graph executes
             # eagerly — per-op dispatch on the op's device, like the
             # reference engine's per-device worker queues
-            fn = core if (self._node2dev or self._naive) else jax.jit(core)
+            fn = _tstep if (self._node2dev or self._naive) else jax.jit(_tstep)
         else:
             raise MXNetError(f"unknown jit kind {kind}")
         self._jit_cache[cache_key] = fn
@@ -373,10 +416,7 @@ class Executor:
         # (their custom_vjp ignores the head grad, so ones is a formality);
         # non-loss heads contribute ZERO — the reference executor doesn't
         # inject gradients for extra outputs like Group(loss, features)
-        head_is_loss = [
-            not node.is_variable and getattr(node.op, "is_loss", False)
-            for (node, _ix) in graph.heads
-        ]
+        head_is_loss = _head_loss_flags(graph)
         if not any(head_is_loss):
             # no loss head at all: an out_grads-less backward would be all
             # zeros; surface the misuse instead (reference executor errors
@@ -462,6 +502,7 @@ class Executor:
         self._args_in = self._arg_vals()
         self._aux_in = self._aux_vals()
         self._fwd_rng = self._rng_key()
+        self._fwd_rng_val = self._step
         if self._monitor_callback is not None or self._naive:
             self._materialize_forward()  # NaiveEngine: synchronous dispatch
         else:
@@ -488,7 +529,10 @@ class Executor:
             )
         else:
             fn = self._get_jit("forward", is_train=is_train)
-            outs, aux_upd = fn(args_in, aux_in, rng)
+            outs, aux_upd, next_step = fn(args_in, aux_in, rng)
+            self._accept_next_step(
+                next_step, getattr(self, "_fwd_rng_val", self._step)
+            )
         self._set_outputs(outs)
         self._set_aux(aux_upd)
         self._pending = None
@@ -530,12 +574,7 @@ class Executor:
         if out_grads is not None and not isinstance(out_grads, (list, tuple)):
             out_grads = [out_grads]
         if out_grads is None:
-            # variable heads count as non-loss: they too contribute zero
-            # gradient without an explicit head grad
-            flags = [
-                not node.is_variable and getattr(node.op, "is_loss", False)
-                for (node, _ix) in self.graph.heads
-            ]
+            flags = _head_loss_flags(self.graph)
             if any(flags) and not all(flags):
                 import warnings
 
@@ -566,6 +605,7 @@ class Executor:
         self._bwd_heads = head_grads
         self._bwd_scheduled = True
         self._bwd_rng = self._rng_key()
+        self._bwd_rng_val = self._step
         for n in self._wrt_names:
             self.grad_dict[n]._set_lazy(self._materialize_backward)
         for h in self._output_handles:
@@ -578,9 +618,12 @@ class Executor:
         head_grads = self._bwd_heads
         with_hg = head_grads is not None
         fn = self._get_jit("train_step", with_head_grads=with_hg)
-        outs, aux_upd, grad_map = fn(
+        outs, aux_upd, grad_map, next_step = fn(
             self._bwd_args, self._bwd_aux, self._bwd_rng, head_grads,
             self._bwd_prev,
+        )
+        self._accept_next_step(
+            next_step, getattr(self, "_bwd_rng_val", self._step)
         )
         self._bwd_scheduled = False  # only consumed on success
         self._set_outputs(outs)
@@ -670,10 +713,17 @@ class Executor:
                     new_params.append(w)
                     new_states.append(s)
                 new_leaves = jax.tree_util.tree_flatten(new_states)[0]
-                return outs, aux_upd, grad_map, new_params, new_leaves
+                # hand the next step its hyperparams without a host round
+                # trip: t advances by one for every updated param each step,
+                # lr/wd only move when a scheduler fires (host re-uploads
+                # then) — so the common-case next hyper is computable here
+                next_hyper = hyper.at[2].add(np.float32(1))
+                return outs, aux_upd, grad_map, new_params, new_leaves, \
+                    next_hyper, _next_step(rng)
 
             plan = (
-                jax.jit(_step, donate_argnums=(0, 2, 6)), upd_idx, other_idx,
+                jax.jit(_step, donate_argnums=(0, 2, 6, 7)), upd_idx,
+                other_idx,
             )
             self._fused_plan[plan_key] = plan
         fn, upd_idx, other_idx = plan
@@ -681,17 +731,40 @@ class Executor:
         args_in = self._bwd_args
         upd_vals = [args_in[i] for i in upd_idx]
         other_vals = [args_in[i] for i in other_idx]
-        # one packed host->device transfer for all per-step hyperparams
-        hyper = np.stack([
+        # Per-step hyperparams stay device-resident: a fresh numpy argument
+        # per execute costs a blocking host->device round trip on tunneled
+        # runtimes and stalls the pipeline. The program returns next step's
+        # hyper (t+1) donated in place; the host keeps a numpy mirror and
+        # re-uploads only when the wanted values diverge (lr schedule fired,
+        # optimizer/param-set changed, first step).
+        hyper_host = np.stack([
             np.asarray(lrs, np.float32),
             np.asarray(wds, np.float32),
             np.asarray(ts, np.float32),
         ])
+        cache = getattr(self, "_hyper_dev_cache", None)
+        if (
+            cache is not None
+            and cache[0] is not None
+            and cache[1].shape == hyper_host.shape
+            and np.array_equal(cache[1], hyper_host)
+        ):
+            hyper = cache[0]
+        else:
+            hyper = jax.device_put(hyper_host)
+        self._hyper_dev_cache = None  # donated below; never reuse on failure
 
-        outs, aux_upd, grad_map, new_params, new_leaves = fn(
-            upd_vals, other_vals, self._bwd_aux, self._bwd_rng, head_grads,
-            self._bwd_prev, state_leaves, hyper,
+        outs, aux_upd, grad_map, new_params, new_leaves, next_hyper, \
+            next_step = fn(
+                upd_vals, other_vals, self._bwd_aux, self._bwd_rng, head_grads,
+                self._bwd_prev, state_leaves, hyper,
+            )
+        self._accept_next_step(
+            next_step, getattr(self, "_bwd_rng_val", self._step)
         )
+        mirror = hyper_host.copy()
+        mirror[2] += 1
+        self._hyper_dev_cache = (next_hyper, mirror)
         self._bwd_scheduled = False  # only consumed on success
         aux_snap = self._bwd_aux
         # snapshots now reference donated buffers — drop them

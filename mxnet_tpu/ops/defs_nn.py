@@ -374,9 +374,23 @@ def _batch_norm(ins, params, mode):
         new_aux = [moving_mean, moving_var]
         out_mean, out_var = moving_mean, moving_var
     else:
-        cdata = data.astype(jnp.float32)
-        mean = jnp.mean(cdata, axis=axes)
-        var = jnp.var(cdata, axis=axes)
+        # One-pass stats: both reductions are independent, so XLA fuses them
+        # into a single read of the activation (jnp.mean followed by jnp.var
+        # chains two full passes — the dominant cost of training BN on a
+        # bandwidth-bound chip). Plain E[x^2]-E[x]^2 catastrophically cancels
+        # in fp32 when |mean| >> std, so the pass is shifted by the moving
+        # mean — a free, gradient-neutral anchor that tracks the batch mean:
+        # var = E[(x-m0)^2] - (mean-m0)^2 with m0 = stop_grad(moving_mean).
+        # fp32 accumulation happens inside the fused reduce; no fp32 copy of
+        # the activation is materialised.
+        n = float(np.prod([data.shape[i] for i in axes]))
+        m0 = jax.lax.stop_gradient(moving_mean).astype(jnp.float32)
+        xc = data.astype(jnp.float32) - m0.reshape(bshape)
+        dmean = jnp.sum(xc, axis=axes) / n
+        mean = m0 + dmean
+        var = jnp.maximum(
+            jnp.sum(xc * xc, axis=axes) / n - dmean * dmean, 0.0
+        )
         new_aux = [
             moving_mean * momentum + jax.lax.stop_gradient(mean) * (1 - momentum),
             moving_var * momentum + jax.lax.stop_gradient(var) * (1 - momentum),
