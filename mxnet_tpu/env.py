@@ -60,6 +60,14 @@ _declare("MXNET_BACKWARD_DO_MIRROR", _parse_bool, False,
          "When true, executors run backward with jax.checkpoint-style "
          "rematerialisation to trade compute for activation memory "
          "(reference mirror option, graph_executor.cc:222-280).")
+_declare("MXNET_XLA_TPU_OPTIONS", str, "",
+         "Comma-separated key=value XLA compiler options attached to every "
+         "executor program when the target is a TPU (ignored on CPU). The "
+         "TPU analogue of the reference's cuDNN autotune/workspace knobs "
+         "(MXNET_CUDNN_AUTOTUNE_DEFAULT, Convolution workspace param) — "
+         "e.g. 'xla_tpu_scoped_vmem_limit_kib=65536' trades fusion VMEM "
+         "budget against pipelining (helps some matmul-heavy programs, "
+         "hurts ResNet-style conv nets; benchmark before setting).")
 
 
 def get(name):

@@ -72,6 +72,29 @@ def _next_step(rng):
     return rng[1] + np.uint32(1)
 
 
+def _tpu_compiler_options(ctx):
+    """XLA compiler options for this executor's programs (TPU targets only).
+
+    The TPU stand-in for the reference's per-device kernel tuning knobs
+    (cuDNN autotune registry / Convolution ``workspace``): a catalogued env
+    var (``MXNET_XLA_TPU_OPTIONS``) carries key=value options to the TPU
+    compiler; CPU-targeted executors get none.
+    """
+    try:
+        if ctx.jax_device().platform == "cpu":
+            return None
+    except Exception:
+        return None
+    from . import env
+
+    opts = {}
+    for item in env.get("MXNET_XLA_TPU_OPTIONS").split(","):
+        k, _, v = item.strip().partition("=")
+        if k:
+            opts[k] = v.strip()
+    return opts or None
+
+
 class _CompiledGraph:
     """The symbol lowered to a pure function over ordered value lists.
 
@@ -381,7 +404,9 @@ class Executor:
                 )
                 return outs, aux_upd, _next_step(rng)
 
-            fn = _fwd if (self._node2dev or self._naive) else jax.jit(_fwd)
+            fn = _fwd if (self._node2dev or self._naive) else jax.jit(
+                _fwd, compiler_options=_tpu_compiler_options(self._ctx)
+            )
         elif kind == "train_step":
             core = self._make_grad_core()
 
@@ -395,7 +420,9 @@ class Executor:
             # (or SPMD-sharded) programs only, so a placed graph executes
             # eagerly — per-op dispatch on the op's device, like the
             # reference engine's per-device worker queues
-            fn = _tstep if (self._node2dev or self._naive) else jax.jit(_tstep)
+            fn = _tstep if (self._node2dev or self._naive) else jax.jit(
+                _tstep, compiler_options=_tpu_compiler_options(self._ctx)
+            )
         else:
             raise MXNetError(f"unknown jit kind {kind}")
         self._jit_cache[cache_key] = fn
@@ -657,9 +684,12 @@ class Executor:
         cache_token : hashable identity of the optimizer config; part of the
             jit cache key.
 
-        Returns the list of new state pytrees. Outputs, aux states, gradient
-        arrays and parameter arrays are updated in place. Requires a
-        scheduled backward(); raises MXNetError otherwise.
+        Returns the list of new state pytrees — unless ``states`` is a
+        pre-flattened ``(leaves, treedef)`` pair, in which case the new flat
+        leaves are returned as-is (the hot-loop interface: the caller keeps
+        the flat structure cached and skips per-step pytree work). Outputs,
+        aux states, gradient arrays and parameter arrays are updated in
+        place. Requires a scheduled backward(); raises MXNetError otherwise.
         """
         import jax
 
@@ -677,7 +707,15 @@ class Executor:
         head_grads = self._bwd_heads
         with_hg = head_grads is not None
 
-        state_leaves, state_td = jax.tree_util.tree_flatten(list(states))
+        flat_in = (
+            isinstance(states, tuple) and len(states) == 2
+            and isinstance(states[0], list)
+            and isinstance(states[1], jax.tree_util.PyTreeDef)
+        )
+        if flat_in:
+            state_leaves, state_td = states
+        else:
+            state_leaves, state_td = jax.tree_util.tree_flatten(list(states))
         plan_key = (tuple(update_names), cache_token, with_hg, state_td)
         plan = self._fused_plan.get(plan_key)
         if plan is None:
@@ -722,11 +760,15 @@ class Executor:
                     next_hyper, _next_step(rng)
 
             plan = (
-                jax.jit(_step, donate_argnums=(0, 2, 6, 7)), upd_idx,
-                other_idx,
+                jax.jit(
+                    _step, donate_argnums=(0, 2, 6, 7),
+                    compiler_options=_tpu_compiler_options(self._ctx),
+                ),
+                upd_idx, other_idx,
+                [None],  # AOT-compiled executable, filled on first call
             )
             self._fused_plan[plan_key] = plan
-        fn, upd_idx, other_idx = plan
+        fn, upd_idx, other_idx, aot = plan
 
         args_in = self._bwd_args
         upd_vals = [args_in[i] for i in upd_idx]
@@ -754,11 +796,17 @@ class Executor:
             hyper = jax.device_put(hyper_host)
         self._hyper_dev_cache = None  # donated below; never reuse on failure
 
+        call_args = (
+            upd_vals, other_vals, self._bwd_aux, self._bwd_rng, head_grads,
+            self._bwd_prev, state_leaves, hyper,
+        )
+        if aot[0] is None:
+            # ahead-of-time compile once, then call the executable directly:
+            # the jit re-dispatch machinery (cache lookup, arg inference)
+            # costs real milliseconds per step at this argument count
+            aot[0] = fn.lower(*call_args).compile()
         outs, aux_upd, grad_map, new_params, new_leaves, next_hyper, \
-            next_step = fn(
-                upd_vals, other_vals, self._bwd_aux, self._bwd_rng, head_grads,
-                self._bwd_prev, state_leaves, hyper,
-            )
+            next_step = aot[0](*call_args)
         self._accept_next_step(
             next_step, getattr(self, "_bwd_rng_val", self._step)
         )
@@ -785,6 +833,8 @@ class Executor:
                 handle._data = w
         self._pending = None
         self._fresh = True
+        if flat_in:
+            return new_leaves
         return jax.tree_util.tree_unflatten(state_td, new_leaves)
 
     # ------------------------------------------------------------------
@@ -889,14 +939,50 @@ class Executor:
     @staticmethod
     def simple_bind(symbol, ctx, grad_req="write", type_dict=None,
                     group2ctx=None, shared_exec=None, in_shardings=None,
-                    **kwargs):
+                    master_params=None, **kwargs):
         """Infer shapes/dtypes and allocate all arrays (reference
-        ``GraphExecutor::Init`` simple_bind path, graph_executor.cc:852)."""
+        ``GraphExecutor::Init`` simple_bind path, graph_executor.cc:852).
+
+        ``master_params`` restricts the master-dtype rule below to the given
+        names (the Module binder passes its parameter list so data-derived
+        extra inputs like RNN begin states keep their inferred dtype); None
+        applies it to every argument not explicitly typed.
+        """
         arg_shapes, _out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
         type_dict = dict(type_dict or {})
         arg_dtypes, _out_dtypes, aux_dtypes = symbol.infer_type(**type_dict)
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
+        # Master-dtype rule (mixed precision, TPU-idiomatic): parameters and
+        # aux states whose dtype was merely INFERRED from low-precision
+        # inputs stay float32 — every layer casts them to the activation
+        # dtype at use (``_castp``), so compute runs bf16 on the MXU while
+        # updates/statistics accumulate in f32. Without this, bf16-data
+        # graphs allocate bf16 weights that the (f32-scalar) optimizer
+        # update then promotes to f32 after one step: a silent full
+        # recompile and a one-step bf16 weight update. Explicitly requested
+        # dtypes — a type_dict entry or Variable(dtype=...) (the __dtype__
+        # attr) — are honored as given (true fp16/bf16-weight recipes).
+        from .base import np_dtype
+
+        explicit = set(type_dict)
+        for n, attrs in symbol.attr_dict().items():
+            if "__dtype__" in attrs:
+                explicit.add(n)
+        eligible = (
+            (lambda n: n not in explicit) if master_params is None
+            else (lambda n, mp=set(master_params): n in mp and n not in explicit)
+        )
+        lowp = {np_dtype("float16"), np_dtype("bfloat16")}
+        arg_dtypes = [
+            np_dtype("float32") if eligible(n) and np_dtype(d) in lowp else d
+            for n, d in zip(arg_names, arg_dtypes)
+        ]
+        aux_dtypes = [
+            np_dtype("float32")
+            if n not in explicit and np_dtype(d) in lowp else d
+            for n, d in zip(aux_names, aux_dtypes)
+        ]
         args = {}
         for n, s, d in zip(arg_names, arg_shapes, arg_dtypes):
             if shared_exec is not None and n in shared_exec.arg_dict and \

@@ -77,7 +77,23 @@ class DataParallelExecutorGroup:
         self._mesh = None
         self._data_sharding = None
         self._param_sharding = None
-        if len(self.contexts) > 1:
+        self._dp_size = 1
+        from ..parallel import mesh as _meshmod
+
+        cur = _meshmod.current_mesh()
+        if cur is not None:
+            # an installed named mesh (with_mesh) takes precedence over the
+            # context list: batch shards over its 'dp' axis (if any), params
+            # replicate unless a __shard__ annotation splits them (tensor
+            # parallelism, parallel/tensor_parallel.py)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._mesh = cur
+            dp = "dp" if "dp" in cur.axis_names else None
+            self._data_sharding = NamedSharding(cur, P(dp))
+            self._param_sharding = NamedSharding(cur, P())
+            self._dp_size = cur.shape[dp] if dp else 1
+        elif len(self.contexts) > 1:
             import jax
             from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -85,6 +101,7 @@ class DataParallelExecutorGroup:
             self._mesh = Mesh(devices, ("dp",))
             self._data_sharding = NamedSharding(self._mesh, P("dp"))
             self._param_sharding = NamedSharding(self._mesh, P())
+            self._dp_size = len(self.contexts)
 
         self.bind_exec(data_shapes, label_shapes, shared_group)
 
@@ -101,10 +118,10 @@ class DataParallelExecutorGroup:
         self.data_names = [d.name for d in self.data_shapes]
         self.label_names = [d.name for d in self.label_shapes]
         self.batch_size = self.data_shapes[0].shape[0]
-        if self._mesh is not None and self.batch_size % len(self.contexts) != 0:
+        if self._mesh is not None and self.batch_size % self._dp_size != 0:
             raise MXNetError(
-                f"batch size {self.batch_size} not divisible by "
-                f"{len(self.contexts)} devices"
+                f"batch size {self.batch_size} not divisible by the data-"
+                f"parallel degree {self._dp_size}"
             )
 
         shape_kwargs = {d.name: d.shape for d in self.data_shapes}
@@ -134,12 +151,31 @@ class DataParallelExecutorGroup:
 
         in_shardings = {}
         if self._mesh is not None:
+            from ..parallel.tensor_parallel import (
+                collect_shard_specs,
+                shard_spec_sharding,
+            )
+
+            specs = collect_shard_specs(self.symbol)
+            arg_shape = (
+                # only TP-annotated graphs pay for the extra shape inference
+                dict(zip(self.arg_names,
+                         self.symbol.infer_shape(**shape_kwargs)[0]))
+                if any(n in specs for n in self.param_names) else {}
+            )
             for n in self.data_names + self.label_names:
                 in_shardings[n] = self._data_sharding
             for n in self.arg_names:
-                if n not in in_shardings:
+                if n in in_shardings:
+                    continue
+                if n in specs and n in self.param_names:
+                    in_shardings[n] = shard_spec_sharding(
+                        self._mesh, specs[n], len(arg_shape[n] or ())
+                    )
+                else:
                     in_shardings[n] = self._param_sharding
 
+        self._in_shardings = in_shardings
         shared_exec = shared_group._exec if shared_group is not None else None
         self._exec = Executor.simple_bind(
             self.symbol,
@@ -148,6 +184,7 @@ class DataParallelExecutorGroup:
             type_dict=type_kwargs,
             shared_exec=shared_exec,
             in_shardings=in_shardings,
+            master_params=self.param_names,
             **shape_kwargs,
         )
         if self._mesh is not None:
@@ -157,7 +194,10 @@ class DataParallelExecutorGroup:
                 arr._data = jax.device_put(arr._data, in_shardings[n])
             for n, arr in self._exec.aux_dict.items():
                 arr._data = jax.device_put(arr._data, self._param_sharding)
-        self.slices = _even_slices(self.batch_size, len(self.contexts))
+        # reference-surface parity (decide_slices): the per-shard batch
+        # ranges; partitioning degree is the mesh's dp axis, not the raw
+        # context count (a (dp,tp) mesh splits the batch dp ways only)
+        self.slices = _even_slices(self.batch_size, self._dp_size)
 
     def reshape(self, data_shapes, label_shapes):
         if (_as_desc_list(data_shapes) == self.data_shapes and
@@ -174,7 +214,8 @@ class DataParallelExecutorGroup:
             for n in self.param_names:
                 if n in self._exec.arg_dict:
                     self._exec.arg_dict[n]._data = jax.device_put(
-                        self._exec.arg_dict[n]._data, self._param_sharding
+                        self._exec.arg_dict[n]._data,
+                        self._in_shardings.get(n, self._param_sharding),
                     )
 
     def get_params(self, arg_params, aux_params):
@@ -258,39 +299,82 @@ class DataParallelExecutorGroup:
         import jax
 
         exe = self._exec
-        keys, names, lrs, wds, ts = [], [], [], [], []
-        nd_states, jax_states = [], []
-        for i, n in enumerate(self.param_names):
-            if n not in exe.arg_dict or exe.grad_req.get(n, "null") == "null":
-                continue
-            w = exe.arg_dict[n]
-            if i not in updater.states:
-                st = optimizer.create_state(i, w)
-                # co-locate state with the weight (sharding-aware) so the
-                # donated jit inputs alias without per-step resharding
-                st = _map_state(
-                    st,
-                    lambda nd: NDArray(
-                        jax.device_put(nd._data, w._data.sharding)
-                    ),
-                )
-                updater.states[i] = st
-            optimizer._update_count(i)
-            keys.append(i)
-            lrs.append(optimizer._get_lr(i))
-            wds.append(optimizer._get_wd(i))
-            ts.append(optimizer._index_update_count[i])
-            names.append(n)
-            nd_states.append(updater.states[i])
-            jax_states.append(_map_state(updater.states[i], lambda nd: nd._data))
+        opt_token = _optimizer_token(optimizer)
+        host = getattr(self, "_fused_host", None)
+        if host is not None and any(
+            updater.states.get(i) is not obj
+            for i, obj in zip(host["keys"], host["state_objs"])
+        ):
+            host = None  # set_states/load replaced the state pytrees
+        if (
+            host is None
+            or host["ids"] != (id(exe), id(optimizer), id(updater))
+            or host["token"] != opt_token
+        ):
+            # one-time structure build: which params update, their optimizer
+            # states as a flat NDArray-leaf list (the per-step loop below is
+            # on the training hot path — at hundreds of parameters, pytree
+            # walks and per-param bookkeeping each step cost milliseconds
+            # of dispatch that the device then idles through)
+            keys, names, nd_states = [], [], []
+            for i, n in enumerate(self.param_names):
+                if (
+                    n not in exe.arg_dict
+                    or exe.grad_req.get(n, "null") == "null"
+                ):
+                    continue
+                w = exe.arg_dict[n]
+                if i not in updater.states:
+                    st = optimizer.create_state(i, w)
+                    # co-locate state with the weight (sharding-aware) so the
+                    # donated jit inputs alias without per-step resharding
+                    st = _map_state(
+                        st,
+                        lambda nd: NDArray(
+                            jax.device_put(nd._data, w._data.sharding)
+                        ),
+                    )
+                    updater.states[i] = st
+                keys.append(i)
+                names.append(n)
+                nd_states.append(updater.states[i])
+            nd_leaves, state_td = jax.tree_util.tree_flatten(
+                [_map_state(st, lambda nd: nd) for st in nd_states],
+                is_leaf=lambda x: isinstance(x, NDArray),
+            )
 
-        def apply_fn(i, wv, gv, sv, lr, wd, t, rng):
-            return optimizer.jax_apply(wv, gv, sv, lr, wd, t, rng)
+            def apply_fn(i, wv, gv, sv, lr, wd, t, rng):
+                return optimizer.jax_apply(wv, gv, sv, lr, wd, t, rng)
+
+            host = {
+                "ids": (id(exe), id(optimizer), id(updater)),
+                "token": opt_token,
+                "keys": keys,
+                "names": names,
+                "nd_leaves": nd_leaves,
+                "state_td": state_td,
+                "apply_fn": apply_fn,
+                # strong refs: identity comparison against live objects is
+                # sound; an id()-only stamp could false-match on address
+                # reuse after a state container is freed
+                "state_objs": [updater.states[i] for i in keys],
+            }
+            self._fused_host = host
+        keys = host["keys"]
+        names = host["names"]
+        nd_leaves = host["nd_leaves"]
+        for i in keys:
+            optimizer._update_count(i)
+        iuc = optimizer._index_update_count
+        lrs = [optimizer._get_lr(i) for i in keys]
+        wds = [optimizer._get_wd(i) for i in keys]
+        ts = [iuc[i] for i in keys]
+        state_leaves = [nd._data for nd in nd_leaves]
 
         try:
-            new_states = exe.fused_train_update(
-                names, apply_fn, jax_states, lrs, wds, ts,
-                cache_token=_optimizer_token(optimizer),
+            new_leaves = exe.fused_train_update(
+                names, host["apply_fn"], (state_leaves, host["state_td"]),
+                lrs, wds, ts, cache_token=opt_token,
             )
         except Exception as e:
             # roll back the update counts so a retried/fallback update sees
@@ -316,8 +400,8 @@ class DataParallelExecutorGroup:
                     "set_params()/load before continuing"
                 ) from e
             raise
-        for nd_st, new_st in zip(nd_states, new_states):
-            _write_state(nd_st, new_st)
+        for nd, leaf in zip(nd_leaves, new_leaves):
+            nd._data = leaf
 
 
 def _optimizer_token(optimizer):
@@ -341,17 +425,6 @@ def _map_state(st, f):
     if isinstance(st, (list, tuple)):
         return tuple(_map_state(x, f) for x in st)
     return f(st)
-
-
-def _write_state(nd_st, new_st):
-    """Write new jax leaves back into the NDArray state pytree in place."""
-    if nd_st is None:
-        return
-    if isinstance(nd_st, (list, tuple)):
-        for a, b in zip(nd_st, new_st):
-            _write_state(a, b)
-        return
-    nd_st._data = new_st
 
 
 def _even_slices(batch_size, num):

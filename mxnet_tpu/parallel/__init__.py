@@ -18,7 +18,10 @@ from .mesh import (
 )
 from .ring_attention import ring_attention, sequence_parallel_sharding
 from .tensor_parallel import (
+    collect_shard_specs,
     column_parallel_spec,
+    parse_shard_spec,
     row_parallel_spec,
+    shard_spec_sharding,
     tp_mlp,
 )

@@ -1,4 +1,4 @@
-"""Tensor (intra-op) parallelism helpers.
+"""Tensor (intra-op) parallelism.
 
 NEW surface relative to the reference (SURVEY.md §2.5 marks tensor
 parallelism absent there): Megatron-style sharded projections expressed as
@@ -14,11 +14,109 @@ over ICI. The two standard layouts:
 
 These compose with ``dp`` batch sharding on the same mesh: annotate, jit,
 and XLA partitions the program across the full mesh.
+
+**Symbol-level API** (the user-facing path, mirroring how the reference
+exposes model parallelism through ``AttrScope(ctx_group=...)`` +
+placement, ``python/mxnet/attribute.py`` / ``graph_executor.cc:286-385``):
+a ``__shard__="axis:dim"`` attribute marks how a parameter is split over
+the installed mesh. It can sit directly on a ``Variable`` or on an op node
+via ``AttrScope`` — an op's spec applies to the op's own parameter inputs
+(auto-created weights/bias), never to data flowing through it:
+
+    with mx.parallel.with_mesh(mx.parallel.make_mesh({"dp": 2, "tp": 4})):
+        data = mx.sym.Variable("data")
+        with mx.AttrScope(__shard__="tp:0"):         # column-parallel
+            net = mx.sym.FullyConnected(data, num_hidden=4096, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        with mx.AttrScope(__shard__="tp:1"):         # row-parallel
+            net = mx.sym.FullyConnected(net, num_hidden=1024, name="fc2")
+        mod = mx.mod.Module(net, ...); mod.bind(...); mod.fit(...)
+
+The executor group resolves the specs to ``NamedSharding``s at bind time;
+GSPMD propagates them through the jitted train step, inserting the
+Megatron all-reduce where the row-parallel contraction closes. A spec dim
+outside a 1-d bias's rank replicates that input, so one scope covers a
+whole layer.
 """
 
 from __future__ import annotations
 
 from ..base import MXNetError
+
+
+def parse_shard_spec(raw):
+    """Parse a ``__shard__`` attribute value: ``"axis"`` or ``"axis:dim"``
+    (dim defaults to 0). Returns (mesh_axis, dim)."""
+    axis, _, dim = str(raw).partition(":")
+    axis = axis.strip()
+    if not axis:
+        raise MXNetError(f"empty mesh axis in __shard__ spec {raw!r}")
+    try:
+        d = int(dim) if dim else 0
+    except ValueError:
+        raise MXNetError(f"bad dim in __shard__ spec {raw!r}") from None
+    if d < 0:
+        raise MXNetError(f"negative dim in __shard__ spec {raw!r}")
+    return axis, d
+
+
+def collect_shard_specs(symbol):
+    """Resolve ``__shard__`` annotations over a symbol's graph.
+
+    Returns {variable_name: (mesh_axis, dim)}. An op node's spec applies to
+    its direct *variable* inputs (the layer's auto-created weights/bias); a
+    spec set on a Variable itself wins over one inherited from a consumer.
+    Aux states (BatchNorm moving stats) are never sharded this way — they
+    are per-channel vectors kept replicated. The caller is responsible for
+    restricting application to parameters (so a scoped spec can never shard
+    the data/label inputs flowing through the layer).
+    """
+    inherited, explicit = {}, {}
+    seen = set()
+    stack = [node for (node, _ix) in symbol._outputs]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        raw = (node.attrs or {}).get("__shard__")
+        if node.is_variable:
+            if raw and not node.is_aux:
+                explicit[node.name] = parse_shard_spec(raw)
+            continue
+        for (inp, _ix) in node.inputs:
+            stack.append(inp)
+            if raw and inp.is_variable and not inp.is_aux:
+                spec = parse_shard_spec(raw)
+                prev = inherited.setdefault(inp.name, spec)
+                if prev != spec:
+                    # a shared parameter under two conflicting scopes must
+                    # not be resolved by traversal order — make the user
+                    # pick one (explicit Variable attr below overrides)
+                    if explicit.get(inp.name) is None and \
+                            (inp.attrs or {}).get("__shard__") is None:
+                        raise MXNetError(
+                            f"conflicting __shard__ specs for {inp.name!r}: "
+                            f"{prev} vs {spec} inherited from different "
+                            "consumers; set the spec on the Variable itself"
+                        )
+    inherited.update(explicit)
+    return inherited
+
+
+def shard_spec_sharding(mesh, spec, ndim):
+    """NamedSharding for (mesh_axis, dim) over ``mesh``; replicated when the
+    dim is outside the array's rank (biases under a layer-wide scope)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis, dim = spec
+    if axis not in mesh.axis_names:
+        raise MXNetError(
+            f"__shard__ axis {axis!r} not in mesh axes {mesh.axis_names}"
+        )
+    if dim >= ndim:
+        return NamedSharding(mesh, P())
+    return NamedSharding(mesh, P(*((None,) * dim + (axis,))))
 
 
 def column_parallel_spec(mesh_axis="tp"):
