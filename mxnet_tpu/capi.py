@@ -1,0 +1,202 @@
+"""Python bridge behind the native core C ABI (``native/c_api.cpp``).
+
+The reference implements its ~150 ``MX*`` C functions directly over the C++
+core (``src/c_api/c_api.cc``); here the C layer is an adapter hosting an
+embedded CPython, and these functions are the narrow, positional-argument
+surface it calls. Keeping the marshalling logic on the Python side keeps
+the C shim small and lets the ABI reuse the framework's own NDArray /
+Symbol / Executor semantics (jax/XLA underneath).
+
+Every function takes/returns only C-friendly values: bytes, str, int,
+tuples and opaque framework objects the shim holds as ``PyObject*``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context
+
+# reference mshadow TypeFlag codes (include/mxnet/tensor_blob.h via mshadow);
+# 12 = bfloat16 extension (the TPU-preferred half type; the reference era
+# predates bf16, later MXNet also picked 12)
+_DTYPE_FROM_CODE = {
+    0: "float32", 1: "float64", 2: "float16", 3: "uint8", 4: "int32",
+    12: "bfloat16",
+}
+_CODE_FROM_DTYPE = {v: k for k, v in _DTYPE_FROM_CODE.items()}
+
+# reference OpReqType (include/mxnet/op_attr_types.h): kNullOp, kWriteTo,
+# kWriteInplace, kAddTo
+_REQ_FROM_CODE = {0: "null", 1: "write", 2: "write", 3: "add"}
+
+
+def _ctx(dev_type, dev_id):
+    if dev_type in (1, 3):  # cpu / cpu_pinned
+        return Context("cpu", dev_id)
+    if dev_type == 4:
+        return Context("tpu", dev_id)
+    return Context("gpu", dev_id)  # 2: accelerator (aliases the TPU chip)
+
+
+def nd_create(shape, dtype_code, dev_type, dev_id):
+    from .ndarray import zeros
+
+    return zeros(tuple(int(s) for s in shape),
+                 ctx=_ctx(dev_type, dev_id),
+                 dtype=_DTYPE_FROM_CODE[int(dtype_code)])
+
+
+def nd_none():
+    from .ndarray import NDArray
+
+    return NDArray(None)
+
+
+def nd_from_bytes(nd, raw):
+    """MXNDArraySyncCopyFromCPU: raw bytes in C order, nd's dtype."""
+    arr = np.frombuffer(raw, dtype=nd.dtype).reshape(nd.shape)
+    nd[:] = arr
+    return None
+
+
+def nd_to_bytes(nd):
+    """MXNDArraySyncCopyToCPU."""
+    return np.ascontiguousarray(nd.asnumpy()).tobytes()
+
+
+def nd_shape(nd):
+    return tuple(int(s) for s in nd.shape)
+
+
+def nd_dtype_code(nd):
+    name = str(np.dtype(nd.dtype))
+    try:
+        return _CODE_FROM_DTYPE[name]
+    except KeyError:
+        raise MXNetError(f"no C dtype code for {name}") from None
+
+
+def nd_itemsize(nd):
+    """Element width in bytes — single source of dtype-size knowledge for
+    the C shim's element-count<->byte conversions."""
+    return int(np.dtype(nd.dtype).itemsize)
+
+
+def nd_context(nd):
+    ctx = nd.context
+    return (int(ctx.device_typeid), int(ctx.device_id))
+
+
+def nd_wait(nd, write=False):
+    nd.wait_to_read()
+    return None
+
+
+def nd_save(fname, nds, keys):
+    from . import ndarray
+
+    if keys:
+        ndarray.save(fname, dict(zip(keys, nds)))
+    else:
+        ndarray.save(fname, list(nds))
+    return None
+
+
+def nd_load(fname):
+    """Returns (list_of_ndarrays, list_of_keys_or_empty)."""
+    from . import ndarray
+
+    loaded = ndarray.load(fname)
+    if isinstance(loaded, dict):
+        keys = list(loaded.keys())
+        return [loaded[k] for k in keys], keys
+    return list(loaded), []
+
+
+def sym_from_json(json_str):
+    from . import symbol
+
+    return symbol.fromjson(json_str)
+
+
+def sym_to_json(sym):
+    return sym.tojson()
+
+
+def sym_list(sym, which):
+    if which == "arguments":
+        return list(sym.list_arguments())
+    if which == "outputs":
+        return list(sym.list_outputs())
+    if which == "auxiliary_states":
+        return list(sym.list_auxiliary_states())
+    raise MXNetError(f"unknown symbol list kind {which!r}")
+
+
+def sym_infer_shape(sym, keys, shapes):
+    """Returns (arg_shapes, out_shapes, aux_shapes, complete) with shapes as
+    tuples (empty tuple = unknown)."""
+    kwargs = {k: tuple(int(d) for d in s) for k, s in zip(keys, shapes)}
+    try:
+        arg_s, out_s, aux_s = sym.infer_shape(**kwargs)
+    except MXNetError:
+        # reference partial-infer contract: unknown stays 0-dim, complete=0
+        arg_s, out_s, aux_s = sym.infer_shape_partial(**kwargs)
+    def clean(lst):
+        return [tuple(int(d) for d in (s or ())) for s in lst]
+    arg_s, out_s, aux_s = clean(arg_s), clean(out_s), clean(aux_s)
+    complete = int(all(len(s) > 0 for s in arg_s + out_s + aux_s))
+    return arg_s, out_s, aux_s, complete
+
+
+def exec_bind(sym, dev_type, dev_id, in_args, arg_grads, req_codes,
+              aux_states):
+    """MXExecutorBind: parallel arrays in list_arguments order."""
+    names = sym.list_arguments()
+    if len(in_args) != len(names):
+        raise MXNetError(
+            f"MXExecutorBind: got {len(in_args)} in_args for {len(names)} "
+            "arguments"
+        )
+    aux_names = sym.list_auxiliary_states()
+    if len(aux_states) != len(aux_names):
+        raise MXNetError(
+            f"MXExecutorBind: got {len(aux_states)} aux_states for "
+            f"{len(aux_names)} auxiliary states"
+        )
+    grad_req = {
+        n: _REQ_FROM_CODE[int(c)] for n, c in zip(names, req_codes)
+    }
+    args_grad = {
+        n: g for n, g in zip(names, arg_grads) if g is not None
+    }
+    exe = sym.bind(
+        _ctx(dev_type, dev_id),
+        args=dict(zip(names, in_args)),
+        args_grad=args_grad or None,
+        grad_req=grad_req,
+        aux_states=dict(zip(sym.list_auxiliary_states(), aux_states)),
+    )
+    return exe
+
+
+def exec_forward(exe, is_train):
+    exe.forward(is_train=bool(is_train))
+    return None
+
+
+def exec_backward(exe, head_grads):
+    exe.backward(list(head_grads) if head_grads else None)
+    return None
+
+
+def exec_outputs(exe):
+    return list(exe.outputs)
+
+
+def list_all_op_names():
+    from .ops import registry
+
+    return sorted(registry._OPS.keys())

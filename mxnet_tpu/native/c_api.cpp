@@ -1,0 +1,591 @@
+// Core C ABI — NDArray / Symbol / Executor over the embedded framework.
+//
+// Reference: src/c_api/c_api.cc + c_api_symbolic.cc + c_api_executor.cc
+// (~150 MX* functions marshalling into the C++ core). The TPU-native build
+// inverts the stack: jax/XLA is the engine and Python is the core, so each
+// MX* function here is a thin adapter calling mxnet_tpu.capi through an
+// embedded CPython interpreter. Same ABI conventions as the reference
+// (0/-1 return codes, MXGetLastError, per-handle scratch for returned
+// pointers) so a C client of the reference's core subset compiles and runs
+// against this header/library unchanged.
+//
+// Build (standalone): g++ -O2 -shared -fPIC c_api.cpp -o libmxtpu_api.so \
+//   -I$(python -c 'import sysconfig;print(sysconfig.get_paths()["include"])') \
+//   -L$(python -c 'import sysconfig;print(sysconfig.get_config_var("LIBDIR"))') \
+//   -lpython3.x
+// Single-file deployment build: tools/amalgamation.py (libmxtpu.so).
+
+#include "capi_common.h"
+
+#include "c_api.h"
+
+namespace mxtpu {
+
+// Opaque handle: a PyObject (NDArray / Symbol / Executor) plus scratch
+// storage that keeps returned pointers alive until the next call on the
+// same handle (the reference keeps such scratch in thread-local stores,
+// c_api_common.h MXAPIThreadLocalEntry).
+struct Handle {
+  PyObject* obj = nullptr;
+  std::vector<std::string> strs;
+  std::vector<const char*> cstrs;
+  std::vector<uint32_t> shape;
+  // infer-shape scratch: flat dims + per-array pointers for 3 groups
+  std::vector<std::vector<uint32_t>> dims[3];
+  std::vector<uint32_t> ndims[3];
+  std::vector<const uint32_t*> dptrs[3];
+  std::string json;
+  ~Handle() {
+    if (obj) {
+      GIL gil;
+      Py_DECREF(obj);
+    }
+  }
+};
+
+inline Handle* H(void* h) { return static_cast<Handle*>(h); }
+
+// call mxnet_tpu.capi.<fn>(args...); returns new ref or nullptr (error set)
+inline PyObject* capi_call(const char* fn, PyObject* args) {
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.capi");
+  if (!mod) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (!f) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  return r;
+}
+
+inline PyObject* shape_tuple(const uint32_t* shape, uint32_t ndim) {
+  PyObject* t = PyTuple_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(shape[i]));
+  return t;
+}
+
+// fill handle string scratch from a python list of str; returns false on err
+inline bool fill_strs(Handle* h, PyObject* list) {
+  Py_ssize_t n = PySequence_Size(list);
+  if (n < 0) return false;
+  h->strs.clear();
+  h->cstrs.clear();
+  h->strs.reserve(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(list, i);
+    const char* c = it ? PyUnicode_AsUTF8(it) : nullptr;
+    if (!c) {
+      Py_XDECREF(it);
+      return false;
+    }
+    h->strs.emplace_back(c);
+    Py_DECREF(it);
+  }
+  for (auto& s : h->strs) h->cstrs.push_back(s.c_str());
+  return true;
+}
+
+// unpack a python list of shape-tuples into group g of the handle scratch
+inline bool fill_shapes(Handle* h, PyObject* list, int g) {
+  Py_ssize_t n = PySequence_Size(list);
+  if (n < 0) return false;
+  h->dims[g].assign(n, {});
+  h->ndims[g].assign(n, 0);
+  h->dptrs[g].assign(n, nullptr);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* shp = PySequence_GetItem(list, i);
+    if (!shp) return false;
+    Py_ssize_t nd = PySequence_Size(shp);
+    for (Py_ssize_t j = 0; j < nd; ++j) {
+      PyObject* d = PySequence_GetItem(shp, j);
+      h->dims[g][i].push_back((uint32_t)PyLong_AsUnsignedLong(d));
+      Py_XDECREF(d);
+    }
+    h->ndims[g][i] = (uint32_t)nd;
+    Py_DECREF(shp);
+  }
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->dptrs[g][i] = h->dims[g][i].empty() ? nullptr : h->dims[g][i].data();
+  return true;
+}
+
+}  // namespace mxtpu
+
+using mxtpu::GIL;
+using mxtpu::H;
+using mxtpu::Handle;
+using mxtpu::capi_call;
+using mxtpu::ensure_python;
+using mxtpu::g_last_error;
+using mxtpu::set_err_from_python;
+
+// run body under GIL; on python error: set message, return -1
+#define MXTPU_API_BEGIN() \
+  ensure_python();        \
+  GIL gil_;               \
+  do {
+#define MXTPU_API_END()            \
+  }                                \
+  while (false);                   \
+  if (PyErr_Occurred()) {          \
+    set_err_from_python();         \
+    return -1;                     \
+  }                                \
+  return 0
+
+extern "C" {
+
+/* ---------------- NDArray ---------------- */
+
+int MXNDArrayCreateNone(NDArrayHandle* out) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("nd_none", PyTuple_New(0));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXNDArrayCreateEx(const uint32_t* shape, uint32_t ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out) {
+  (void)delay_alloc;  // XLA buffers allocate on first write regardless
+  MXTPU_API_BEGIN();
+  PyObject* args = PyTuple_New(4);
+  PyTuple_SET_ITEM(args, 0, mxtpu::shape_tuple(shape, ndim));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(dtype));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(dev_type));
+  PyTuple_SET_ITEM(args, 3, PyLong_FromLong(dev_id));
+  PyObject* r = capi_call("nd_create", args);
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle* out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc,
+                           /*dtype=float32*/ 0, out);
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  ensure_python();
+  delete H(handle);
+  return 0;
+}
+
+// element width from the python side (single source of dtype knowledge);
+// returns 0 with the error string set on failure
+static size_t nd_itemsize(NDArrayHandle handle) {
+  PyObject* w = capi_call("nd_itemsize", Py_BuildValue("(O)", H(handle)->obj));
+  if (!w) {
+    set_err_from_python();
+    return 0;
+  }
+  long v = PyLong_AsLong(w);
+  Py_DECREF(w);
+  return v > 0 ? (size_t)v : 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size) {
+  MXTPU_API_BEGIN();
+  // size is an element count (reference c_api.h MXNDArraySyncCopyFromCPU)
+  size_t w = nd_itemsize(handle);
+  if (w == 0) return -1;
+  PyObject* raw =
+      PyBytes_FromStringAndSize((const char*)data, size * w);
+  PyObject* r =
+      capi_call("nd_from_bytes", Py_BuildValue("(ON)", H(handle)->obj, raw));
+  Py_XDECREF(r);
+  MXTPU_API_END();
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
+  MXTPU_API_BEGIN();
+  size_t w = nd_itemsize(handle);
+  if (w == 0) return -1;
+  PyObject* r =
+      capi_call("nd_to_bytes", Py_BuildValue("(O)", H(handle)->obj));
+  if (!r) break;
+  char* buf;
+  Py_ssize_t len;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    break;
+  }
+  // size is an element count and must match the array exactly — the
+  // reference CHECK_EQs it against arr.Size(); a lenient check here would
+  // memcpy past a smaller caller buffer
+  if ((size_t)len != size * w) {
+    Py_DECREF(r);
+    g_last_error = "SyncCopyToCPU: size does not match array";
+    return -1;
+  }
+  memcpy(data, buf, len);
+  Py_DECREF(r);
+  MXTPU_API_END();
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, uint32_t* out_dim,
+                      const uint32_t** out_pdata) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("nd_shape", Py_BuildValue("(O)", H(handle)->obj));
+  if (!r) break;
+  Handle* h = H(handle);
+  Py_ssize_t n = PySequence_Size(r);
+  h->shape.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(r, i);
+    h->shape[i] = (uint32_t)PyLong_AsUnsignedLong(it);
+    Py_XDECREF(it);
+  }
+  Py_DECREF(r);
+  *out_dim = (uint32_t)n;
+  *out_pdata = h->shape.data();
+  MXTPU_API_END();
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype) {
+  MXTPU_API_BEGIN();
+  PyObject* r =
+      capi_call("nd_dtype_code", Py_BuildValue("(O)", H(handle)->obj));
+  if (!r) break;
+  *out_dtype = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  MXTPU_API_END();
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                        int* out_dev_id) {
+  MXTPU_API_BEGIN();
+  PyObject* r =
+      capi_call("nd_context", Py_BuildValue("(O)", H(handle)->obj));
+  if (!r) break;
+  *out_dev_type = (int)PyLong_AsLong(PyTuple_GET_ITEM(r, 0));
+  *out_dev_id = (int)PyLong_AsLong(PyTuple_GET_ITEM(r, 1));
+  Py_DECREF(r);
+  MXTPU_API_END();
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("nd_wait", Py_BuildValue("(O)", H(handle)->obj));
+  Py_XDECREF(r);
+  MXTPU_API_END();
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  return MXNDArrayWaitToRead(handle);
+}
+
+int MXNDArrayWaitAll() {
+  // per-var ordering is the runtime's job under XLA (SURVEY §2.1 mapping);
+  // a global fence is a no-op beyond ensuring the interpreter is alive
+  ensure_python();
+  return 0;
+}
+
+int MXNDArraySave(const char* fname, uint32_t num_args, NDArrayHandle* args,
+                  const char** keys) {
+  MXTPU_API_BEGIN();
+  PyObject* nds = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    Py_INCREF(H(args[i])->obj);
+    PyList_SET_ITEM(nds, i, H(args[i])->obj);
+  }
+  PyObject* klist;
+  if (keys) {
+    klist = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; ++i)
+      PyList_SET_ITEM(klist, i, PyUnicode_FromString(keys[i]));
+  } else {
+    klist = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject* r =
+      capi_call("nd_save", Py_BuildValue("(sNN)", fname, nds, klist));
+  Py_XDECREF(r);
+  MXTPU_API_END();
+}
+
+int MXNDArrayLoad(const char* fname, uint32_t* out_size,
+                  NDArrayHandle** out_arr, uint32_t* out_name_size,
+                  const char*** out_names) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("nd_load", Py_BuildValue("(s)", fname));
+  if (!r) break;
+  PyObject* nds = PyTuple_GET_ITEM(r, 0);
+  PyObject* keys = PyTuple_GET_ITEM(r, 1);
+  Py_ssize_t n = PySequence_Size(nds);
+  // the returned handle array + name scratch live in a dedicated holder
+  // handle, exactly like the reference's thread-local ret store; the
+  // holder leaks by design (process-lifetime), the NDArray handles are
+  // the caller's to free
+  static thread_local std::vector<NDArrayHandle> ret_handles;
+  static thread_local Handle name_holder;
+  ret_handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    Handle* h = new Handle();
+    h->obj = PySequence_GetItem(nds, i);  // new ref
+    ret_handles.push_back(h);
+  }
+  if (!mxtpu::fill_strs(&name_holder, keys)) {
+    Py_DECREF(r);
+    break;
+  }
+  Py_DECREF(r);
+  *out_size = (uint32_t)n;
+  *out_arr = ret_handles.data();
+  *out_name_size = (uint32_t)name_holder.cstrs.size();
+  *out_names = name_holder.cstrs.data();
+  MXTPU_API_END();
+}
+
+/* ---------------- Symbol ---------------- */
+
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("sym_from_json", Py_BuildValue("(s)", json));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  MXTPU_API_BEGIN();
+  FILE* f = fopen(fname, "rb");
+  if (!f) {
+    g_last_error = std::string("cannot open ") + fname;
+    return -1;
+  }
+  std::string json;
+  char buf[1 << 16];
+  size_t got;
+  while ((got = fread(buf, 1, sizeof(buf), f)) > 0) json.append(buf, got);
+  fclose(f);
+  PyObject* r = capi_call("sym_from_json", Py_BuildValue("(s)", json.c_str()));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char** out_json) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("sym_to_json", Py_BuildValue("(O)", H(symbol)->obj));
+  if (!r) break;
+  const char* c = PyUnicode_AsUTF8(r);
+  if (!c) {
+    Py_DECREF(r);
+    break;
+  }
+  H(symbol)->json = c;
+  Py_DECREF(r);
+  *out_json = H(symbol)->json.c_str();
+  MXTPU_API_END();
+}
+
+int MXSymbolFree(SymbolHandle symbol) {
+  ensure_python();
+  delete H(symbol);
+  return 0;
+}
+
+static int sym_list_impl(SymbolHandle symbol, const char* which,
+                         uint32_t* out_size, const char*** out_str_array) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(
+      "sym_list", Py_BuildValue("(Os)", H(symbol)->obj, which));
+  if (!r) break;
+  bool ok = mxtpu::fill_strs(H(symbol), r);
+  Py_DECREF(r);
+  if (!ok) break;
+  *out_size = (uint32_t)H(symbol)->cstrs.size();
+  *out_str_array = H(symbol)->cstrs.data();
+  MXTPU_API_END();
+}
+
+int MXSymbolListArguments(SymbolHandle symbol, uint32_t* out_size,
+                          const char*** out_str_array) {
+  return sym_list_impl(symbol, "arguments", out_size, out_str_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle symbol, uint32_t* out_size,
+                        const char*** out_str_array) {
+  return sym_list_impl(symbol, "outputs", out_size, out_str_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, uint32_t* out_size,
+                                const char*** out_str_array) {
+  return sym_list_impl(symbol, "auxiliary_states", out_size, out_str_array);
+}
+
+int MXSymbolInferShape(SymbolHandle symbol, uint32_t num_args,
+                       const char** keys, const uint32_t* arg_ind_ptr,
+                       const uint32_t* arg_shape_data,
+                       uint32_t* in_shape_size, const uint32_t** in_shape_ndim,
+                       const uint32_t*** in_shape_data,
+                       uint32_t* out_shape_size,
+                       const uint32_t** out_shape_ndim,
+                       const uint32_t*** out_shape_data,
+                       uint32_t* aux_shape_size,
+                       const uint32_t** aux_shape_ndim,
+                       const uint32_t*** aux_shape_data, int* complete) {
+  MXTPU_API_BEGIN();
+  PyObject* klist = PyList_New(num_args);
+  PyObject* slist = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(klist, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(
+        slist, i,
+        mxtpu::shape_tuple(arg_shape_data + arg_ind_ptr[i],
+                           arg_ind_ptr[i + 1] - arg_ind_ptr[i]));
+  }
+  PyObject* r = capi_call(
+      "sym_infer_shape",
+      Py_BuildValue("(ONN)", H(symbol)->obj, klist, slist));
+  if (!r) break;
+  Handle* h = H(symbol);
+  bool ok = true;
+  for (int g = 0; g < 3; ++g)
+    ok = ok && mxtpu::fill_shapes(h, PyTuple_GET_ITEM(r, g), g);
+  *complete = (int)PyLong_AsLong(PyTuple_GET_ITEM(r, 3));
+  Py_DECREF(r);
+  if (!ok) break;
+  *in_shape_size = (uint32_t)h->ndims[0].size();
+  *in_shape_ndim = h->ndims[0].data();
+  *in_shape_data = h->dptrs[0].data();
+  *out_shape_size = (uint32_t)h->ndims[1].size();
+  *out_shape_ndim = h->ndims[1].data();
+  *out_shape_data = h->dptrs[1].data();
+  *aux_shape_size = (uint32_t)h->ndims[2].size();
+  *aux_shape_ndim = h->ndims[2].data();
+  *aux_shape_data = h->dptrs[2].data();
+  MXTPU_API_END();
+}
+
+/* ---------------- Executor ---------------- */
+
+int MXExecutorBind(SymbolHandle symbol, int dev_type, int dev_id,
+                   uint32_t len, NDArrayHandle* in_args,
+                   NDArrayHandle* arg_grad_store, uint32_t* grad_req_type,
+                   uint32_t aux_states_len, NDArrayHandle* aux_states,
+                   ExecutorHandle* out) {
+  MXTPU_API_BEGIN();
+  PyObject* args_l = PyList_New(len);
+  PyObject* grads_l = PyList_New(len);
+  PyObject* reqs_l = PyList_New(len);
+  for (uint32_t i = 0; i < len; ++i) {
+    Py_INCREF(H(in_args[i])->obj);
+    PyList_SET_ITEM(args_l, i, H(in_args[i])->obj);
+    if (arg_grad_store && arg_grad_store[i]) {
+      Py_INCREF(H(arg_grad_store[i])->obj);
+      PyList_SET_ITEM(grads_l, i, H(arg_grad_store[i])->obj);
+    } else {
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(grads_l, i, Py_None);
+    }
+    PyList_SET_ITEM(
+        reqs_l, i,
+        PyLong_FromUnsignedLong(grad_req_type ? grad_req_type[i] : 0));
+  }
+  PyObject* aux_l = PyList_New(aux_states_len);
+  for (uint32_t i = 0; i < aux_states_len; ++i) {
+    Py_INCREF(H(aux_states[i])->obj);
+    PyList_SET_ITEM(aux_l, i, H(aux_states[i])->obj);
+  }
+  PyObject* r = capi_call(
+      "exec_bind",
+      Py_BuildValue("(OiiNNNN)", H(symbol)->obj, dev_type, dev_id, args_l,
+                    grads_l, reqs_l, aux_l));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(
+      "exec_forward", Py_BuildValue("(Oi)", H(handle)->obj, is_train));
+  Py_XDECREF(r);
+  MXTPU_API_END();
+}
+
+int MXExecutorBackward(ExecutorHandle handle, uint32_t len,
+                       NDArrayHandle* head_grads) {
+  MXTPU_API_BEGIN();
+  PyObject* hg;
+  if (len == 0) {
+    hg = Py_None;
+    Py_INCREF(Py_None);
+  } else {
+    hg = PyList_New(len);
+    for (uint32_t i = 0; i < len; ++i) {
+      Py_INCREF(H(head_grads[i])->obj);
+      PyList_SET_ITEM(hg, i, H(head_grads[i])->obj);
+    }
+  }
+  PyObject* r = capi_call(
+      "exec_backward", Py_BuildValue("(ON)", H(handle)->obj, hg));
+  Py_XDECREF(r);
+  MXTPU_API_END();
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, uint32_t* out_size,
+                      NDArrayHandle** out) {
+  MXTPU_API_BEGIN();
+  PyObject* r =
+      capi_call("exec_outputs", Py_BuildValue("(O)", H(handle)->obj));
+  if (!r) break;
+  static thread_local std::vector<NDArrayHandle> ret_handles;
+  ret_handles.clear();
+  Py_ssize_t n = PySequence_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    Handle* h = new Handle();
+    h->obj = PySequence_GetItem(r, i);  // new ref — caller frees
+    ret_handles.push_back(h);
+  }
+  Py_DECREF(r);
+  *out_size = (uint32_t)n;
+  *out = ret_handles.data();
+  MXTPU_API_END();
+}
+
+int MXExecutorFree(ExecutorHandle handle) {
+  ensure_python();
+  delete H(handle);
+  return 0;
+}
+
+/* ---------------- registry ---------------- */
+
+int MXListAllOpNames(uint32_t* out_size, const char*** out_array) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("list_all_op_names", PyTuple_New(0));
+  if (!r) break;
+  static thread_local Handle holder;
+  bool ok = mxtpu::fill_strs(&holder, r);
+  Py_DECREF(r);
+  if (!ok) break;
+  *out_size = (uint32_t)holder.cstrs.size();
+  *out_array = holder.cstrs.data();
+  MXTPU_API_END();
+}
+
+}  // extern "C"

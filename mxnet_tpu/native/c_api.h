@@ -1,0 +1,115 @@
+/*!
+ * Core C ABI of the TPU-native framework.
+ *
+ * Function names, signatures and conventions mirror the reference's
+ * include/mxnet/c_api.h (the subset every language binding actually sits
+ * on: NDArray create/copy/save-load, Symbol from/to JSON + introspection +
+ * shape inference, Executor bind/forward/backward/outputs). A C program
+ * written against the reference's core subset compiles against this header
+ * unchanged.
+ *
+ * Conventions (reference c_api.h:1-60):
+ *  - every function returns 0 on success, nonzero on failure;
+ *    MXGetLastError() returns the (thread-local) failure message
+ *  - returned const char* / pointer arrays stay valid until the next call
+ *    on the same handle (they live in per-handle scratch storage)
+ *  - handles must be freed with their MX*Free function
+ *
+ * dtype codes (reference mshadow TypeFlag): 0=float32 1=float64 2=float16
+ * 3=uint8 4=int32; extension: 12=bfloat16 (the TPU-preferred half type).
+ * grad_req codes (reference OpReqType): 0=null 1=write 3=add.
+ * dev_type: 1=cpu 2=gpu(accelerator; the TPU chip here) 3=cpu_pinned.
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stddef.h>
+#include <stdint.h>
+
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+
+const char* MXGetLastError();
+
+/* ---------------- NDArray ---------------- */
+int MXNDArrayCreateNone(NDArrayHandle* out);
+int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle* out);
+int MXNDArrayCreateEx(const uint32_t* shape, uint32_t ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size);
+int MXNDArrayGetShape(NDArrayHandle handle, uint32_t* out_dim,
+                      const uint32_t** out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype);
+int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                        int* out_dev_id);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitToWrite(NDArrayHandle handle);
+int MXNDArrayWaitAll();
+/* reference-binary-compatible .params container (src/ndarray/ndarray.cc) */
+int MXNDArraySave(const char* fname, uint32_t num_args, NDArrayHandle* args,
+                  const char** keys);
+int MXNDArrayLoad(const char* fname, uint32_t* out_size,
+                  NDArrayHandle** out_arr, uint32_t* out_name_size,
+                  const char*** out_names);
+
+/* ---------------- Symbol ---------------- */
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out);
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char** out_json);
+int MXSymbolFree(SymbolHandle symbol);
+int MXSymbolListArguments(SymbolHandle symbol, uint32_t* out_size,
+                          const char*** out_str_array);
+int MXSymbolListOutputs(SymbolHandle symbol, uint32_t* out_size,
+                        const char*** out_str_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, uint32_t* out_size,
+                                const char*** out_str_array);
+/* CSR-style shape args like the reference (c_api_symbolic.cc): keys +
+ * (indptr, flat dims). Outputs: per-array ndim + dims, valid until the next
+ * call on this symbol handle. */
+int MXSymbolInferShape(SymbolHandle symbol, uint32_t num_args,
+                       const char** keys, const uint32_t* arg_ind_ptr,
+                       const uint32_t* arg_shape_data,
+                       uint32_t* in_shape_size, const uint32_t** in_shape_ndim,
+                       const uint32_t*** in_shape_data,
+                       uint32_t* out_shape_size,
+                       const uint32_t** out_shape_ndim,
+                       const uint32_t*** out_shape_data,
+                       uint32_t* aux_shape_size,
+                       const uint32_t** aux_shape_ndim,
+                       const uint32_t*** aux_shape_data, int* complete);
+
+/* ---------------- Executor ---------------- */
+/* in_args/arg_grad_store/grad_req_type are parallel to
+ * MXSymbolListArguments order; arg_grad_store entries may be NULL
+ * (reference MXExecutorBind, c_api_executor.cc:98). */
+int MXExecutorBind(SymbolHandle symbol, int dev_type, int dev_id,
+                   uint32_t len, NDArrayHandle* in_args,
+                   NDArrayHandle* arg_grad_store, uint32_t* grad_req_type,
+                   uint32_t aux_states_len, NDArrayHandle* aux_states,
+                   ExecutorHandle* out);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+int MXExecutorBackward(ExecutorHandle handle, uint32_t len,
+                       NDArrayHandle* head_grads);
+/* returned handles are NEW references the caller must MXNDArrayFree */
+int MXExecutorOutputs(ExecutorHandle handle, uint32_t* out_size,
+                      NDArrayHandle** out);
+int MXExecutorFree(ExecutorHandle handle);
+
+/* ---------------- registry ---------------- */
+int MXListAllOpNames(uint32_t* out_size, const char*** out_array);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_API_H_ */

@@ -13,13 +13,9 @@
 //        -I$(python -c 'import sysconfig;print(sysconfig.get_paths()["include"])') \
 //        -lpython3.12 -L/usr/local/lib
 
-#include <Python.h>
+#include "capi_common.h"
 
-#include <cstdint>
-#include <cstring>
-#include <mutex>
-#include <string>
-#include <vector>
+#include "c_predict_api.h"
 
 namespace {
 
@@ -28,49 +24,15 @@ struct Predictor {
   std::vector<uint32_t> out_shape;         // scratch for GetOutputShape
 };
 
-// per-thread like the reference's thread-local error string (c_api_error.cc)
-thread_local std::string g_last_error;
-
-void set_err_from_python() {
-  PyObject *type, *value, *tb;
-  PyErr_Fetch(&type, &value, &tb);
-  if (value) {
-    PyObject* s = PyObject_Str(value);
-    const char* c = s ? PyUnicode_AsUTF8(s) : nullptr;
-    g_last_error = c ? c : "unknown python error";
-    PyErr_Clear();  // AsUTF8 may itself have raised
-    Py_XDECREF(s);
-  } else {
-    g_last_error = "unknown error";
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-}
-
-std::once_flag g_init_once;
-
-bool ensure_python() {
-  // once_flag: two threads racing into MXPredCreate must not double-init
-  std::call_once(g_init_once, []() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      // release the GIL the init thread holds, or every later
-      // PyGILState_Ensure from another thread deadlocks (multithreaded
-      // inference servers are the primary ABI consumer)
-      PyEval_SaveThread();
-    }
-  });
-  return true;
-}
+using mxtpu::ensure_python;
+using mxtpu::g_last_error;
+using mxtpu::set_err_from_python;
 
 }  // namespace
 
 extern "C" {
 
 typedef void* PredictorHandle;
-
-const char* MXGetLastError() { return g_last_error.c_str(); }
 
 // Mirrors MXPredCreate (c_predict_api.h): input shapes arrive as a CSR-style
 // (indptr, flat dims) pair per input key.

@@ -1,0 +1,40 @@
+/*!
+ * Standalone inference C ABI (reference include/mxnet/c_predict_api.h):
+ * create a predictor from symbol JSON + a .params blob, set inputs, run
+ * forward, read outputs. Deployment surface for C/C++/mobile clients and
+ * the amalgamation build (tools/amalgamation.py).
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stdint.h>
+
+typedef void* PredictorHandle;
+
+const char* MXGetLastError();
+
+/* input shapes arrive as a CSR-style (indptr, flat dims) pair per key,
+ * exactly like the reference MXPredCreate */
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, PredictorHandle* out);
+int MXPredSetInput(PredictorHandle handle, const char* key, const float* data,
+                   uint32_t size);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         uint32_t** shape_data, uint32_t* shape_ndim);
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
+                    uint32_t size);
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_PREDICT_API_H_ */

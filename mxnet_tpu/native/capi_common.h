@@ -1,0 +1,80 @@
+// Shared infrastructure for the C ABI shims (c_api.cpp, c_predict_api.cpp).
+//
+// Reference: src/c_api/c_api_common.h + c_api_error.cc — thread-local error
+// string, API_BEGIN/API_END macros. Here the common layer also owns the
+// embedded-CPython bootstrap: the TPU build's C ABI is an adapter over the
+// Python framework (jax/XLA is the engine), so every shim needs a live
+// interpreter and GIL discipline.
+//
+// Everything here is header-only (inline / thread_local / weak) so the file
+// can be included by standalone shim builds AND by the single-file
+// amalgamation (tools/amalgamation.py) without duplicate definitions.
+#ifndef MXTPU_CAPI_COMMON_H_
+#define MXTPU_CAPI_COMMON_H_
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+// per-thread like the reference's thread-local error string (c_api_error.cc)
+inline thread_local std::string g_last_error;
+
+inline void set_err_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    const char* c = s ? PyUnicode_AsUTF8(s) : nullptr;
+    g_last_error = c ? c : "unknown python error";
+    PyErr_Clear();  // AsUTF8 may itself have raised
+    Py_XDECREF(s);
+  } else {
+    g_last_error = "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+inline std::once_flag& init_once() {
+  static std::once_flag flag;
+  return flag;
+}
+
+inline bool ensure_python() {
+  // once_flag: two threads racing into the first API call must not
+  // double-init the interpreter
+  std::call_once(init_once(), []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL the init thread holds, or every later
+      // PyGILState_Ensure from another thread deadlocks (multithreaded
+      // inference servers are the primary ABI consumer)
+      PyEval_SaveThread();
+    }
+  });
+  return true;
+}
+
+// RAII GIL scope for the shims
+struct GIL {
+  PyGILState_STATE state;
+  GIL() : state(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state); }
+};
+
+}  // namespace mxtpu
+
+// Weak so that the standalone predict shim, the standalone core shim and
+// the amalgamated single .so each link exactly one definition.
+extern "C" __attribute__((weak)) const char* MXGetLastError() {
+  return mxtpu::g_last_error.c_str();
+}
+
+#endif  // MXTPU_CAPI_COMMON_H_

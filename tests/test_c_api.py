@@ -1,0 +1,242 @@
+"""Core C ABI end-to-end: a pure-C program runs LeNet inference.
+
+Reference parity: the ~150-function C ABI (include/mxnet/c_api.h,
+src/c_api/c_api.cc) is the foundation all language bindings sit on
+(SURVEY.md §1 layers 9-11). This test exercises the TPU-native core subset
+exactly the way a binding would: build the amalgamated single .so + header
+(tools/amalgamation.py — the reference's amalgamation/ analogue), compile a
+plain-C client against them, and have it load a symbol JSON + .params
+checkpoint, bind an executor, run forward and print the output — which must
+match the Python framework bit-for-bit (same XLA program underneath).
+"""
+
+import os
+import subprocess
+import sysconfig
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.test_utils import assert_almost_equal
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_C_CLIENT = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "mxtpu.h"
+
+#define CHK(x) if ((x) != 0) { \
+  fprintf(stderr, "FAIL %s: %s\n", #x, MXGetLastError()); return 1; }
+
+/* strict C99: strdup is POSIX-only (an implicit declaration would truncate
+ * the returned pointer on LP64 and crash) */
+static char* dupstr(const char* s) {
+  size_t n = strlen(s) + 1;
+  char* p = malloc(n);
+  memcpy(p, s, n);
+  return p;
+}
+
+int main(int argc, char** argv) {
+  const char* sym_file = argv[1];
+  const char* param_file = argv[2];
+
+  SymbolHandle sym;
+  CHK(MXSymbolCreateFromFile(sym_file, &sym));
+
+  uint32_t n_args, n_aux;
+  const char **arg_names, **aux_names;
+  CHK(MXSymbolListArguments(sym, &n_args, &arg_names));
+  /* copy: the scratch is reused by later calls on this handle */
+  char** args_copy = malloc(n_args * sizeof(char*));
+  for (uint32_t i = 0; i < n_args; ++i) args_copy[i] = dupstr(arg_names[i]);
+  CHK(MXSymbolListAuxiliaryStates(sym, &n_aux, &aux_names));
+  char** aux_copy = malloc(n_aux * sizeof(char*));
+  for (uint32_t i = 0; i < n_aux; ++i) aux_copy[i] = dupstr(aux_names[i]);
+
+  /* load the checkpoint (arg:/aux: prefixed keys, reference format) */
+  uint32_t n_loaded, n_names;
+  NDArrayHandle* loaded;
+  const char** loaded_names;
+  CHK(MXNDArrayLoad(param_file, &n_loaded, &loaded, &n_names, &loaded_names));
+  NDArrayHandle* loaded_copy = malloc(n_loaded * sizeof(NDArrayHandle));
+  char** lnames = malloc(n_loaded * sizeof(char*));
+  for (uint32_t i = 0; i < n_loaded; ++i) {
+    loaded_copy[i] = loaded[i];
+    lnames[i] = dupstr(loaded_names[i]);
+  }
+
+  /* infer shapes from the data shape to size data/label arrays */
+  const char* keys[] = {"data"};
+  uint32_t indptr[] = {0, 4};
+  uint32_t dims[] = {2, 1, 28, 28};
+  uint32_t in_size, out_size_s, aux_size;
+  const uint32_t *in_ndim, *out_ndim_s, *aux_ndim;
+  const uint32_t **in_dims, **out_dims_s, **aux_dims;
+  int complete;
+  CHK(MXSymbolInferShape(sym, 1, keys, indptr, dims, &in_size, &in_ndim,
+                         &in_dims, &out_size_s, &out_ndim_s, &out_dims_s,
+                         &aux_size, &aux_ndim, &aux_dims, &complete));
+  if (!complete) { fprintf(stderr, "infer incomplete\n"); return 1; }
+
+  /* build in_args: params from checkpoint, data/label created here */
+  NDArrayHandle* in_args = malloc(n_args * sizeof(NDArrayHandle));
+  uint32_t* req = malloc(n_args * sizeof(uint32_t));
+  for (uint32_t i = 0; i < n_args; ++i) {
+    req[i] = 0; /* null: inference */
+    in_args[i] = NULL;
+    char key[256];
+    snprintf(key, sizeof key, "arg:%s", args_copy[i]);
+    for (uint32_t j = 0; j < n_loaded; ++j)
+      if (strcmp(lnames[j], key) == 0) in_args[i] = loaded_copy[j];
+    if (!in_args[i]) { /* data or label: create from inferred shape */
+      CHK(MXNDArrayCreate(in_dims[i], in_ndim[i], 1, 0, 0, &in_args[i]));
+    }
+  }
+  NDArrayHandle* aux = malloc((n_aux ? n_aux : 1) * sizeof(NDArrayHandle));
+  for (uint32_t i = 0; i < n_aux; ++i) {
+    aux[i] = NULL;
+    char key[256];
+    snprintf(key, sizeof key, "aux:%s", aux_copy[i]);
+    for (uint32_t j = 0; j < n_loaded; ++j)
+      if (strcmp(lnames[j], key) == 0) aux[i] = loaded_copy[j];
+    if (!aux[i]) {
+      CHK(MXNDArrayCreate(aux_dims[i], aux_ndim[i], 1, 0, 0, &aux[i]));
+    }
+  }
+
+  /* feed a deterministic input */
+  float* input = malloc(2 * 28 * 28 * sizeof(float));
+  for (int i = 0; i < 2 * 28 * 28; ++i) input[i] = (float)(i % 29) / 29.0f;
+  for (uint32_t i = 0; i < n_args; ++i) {
+    if (strcmp(args_copy[i], "data") == 0)
+      CHK(MXNDArraySyncCopyFromCPU(in_args[i], input, 2 * 28 * 28));
+  }
+
+  ExecutorHandle exe;
+  CHK(MXExecutorBind(sym, 1, 0, n_args, in_args, NULL, req, n_aux, aux,
+                     &exe));
+  CHK(MXExecutorForward(exe, 0));
+
+  uint32_t n_out;
+  NDArrayHandle* outs;
+  CHK(MXExecutorOutputs(exe, &n_out, &outs));
+  uint32_t od;
+  const uint32_t* oshape;
+  CHK(MXNDArrayGetShape(outs[0], &od, &oshape));
+  uint32_t total = 1;
+  for (uint32_t i = 0; i < od; ++i) total *= oshape[i];
+  float* out = malloc(total * sizeof(float));
+  CHK(MXNDArraySyncCopyToCPU(outs[0], out, total));
+  for (uint32_t i = 0; i < total; ++i) printf("%.6f\n", out[i]);
+
+  /* sanity on the registry surface too */
+  uint32_t n_ops; const char** op_names;
+  CHK(MXListAllOpNames(&n_ops, &op_names));
+  if (n_ops < 100) { fprintf(stderr, "op registry too small\n"); return 1; }
+
+  CHK(MXExecutorFree(exe));
+  CHK(MXSymbolFree(sym));
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def amalgamated(tmp_path_factory):
+    out_dir = str(tmp_path_factory.mktemp("amal"))
+    r = subprocess.run(
+        ["python", os.path.join(_ROOT, "tools", "amalgamation.py"),
+         "--out-dir", out_dir],
+        capture_output=True, text=True, cwd=_ROOT,
+    )
+    assert r.returncode == 0, r.stderr
+    return out_dir
+
+
+def test_pure_c_lenet_inference(amalgamated, tmp_path):
+    # LeNet checkpoint written by the Python framework
+    sym = models.lenet(num_classes=10)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 1, 28, 28))],
+             label_shapes=[("softmax_label", (2,))])
+    mx.random.seed(7)
+    mod.init_params(initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "lenet")
+    mod.save_checkpoint(prefix, 0)
+
+    # compile the pure-C client against the single header + .so
+    csrc = str(tmp_path / "client.c")
+    with open(csrc, "w") as f:
+        f.write(_C_CLIENT)
+    client = str(tmp_path / "client")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    r = subprocess.run(
+        ["gcc", "-std=c99", "-O2", csrc, "-o", client,
+         f"-I{amalgamated}", os.path.join(amalgamated, "libmxtpu.so"),
+         f"-Wl,-rpath,{amalgamated}", f"-Wl,-rpath,{libdir}"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [client, prefix + "-symbol.json", prefix + "-0000.params"],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr + r.stdout
+    got = np.array([float(x) for x in r.stdout.split()], np.float32)
+
+    # oracle: the same forward through the Python API
+    x = (np.arange(2 * 28 * 28, dtype=np.float32) % 29 / 29.0).reshape(
+        2, 1, 28, 28)
+    mod.forward(mx.io.DataBatch([mx.nd.array(x)], []), is_train=False)
+    expect = mod.get_outputs()[0].asnumpy().ravel()
+    assert got.shape == expect.shape
+    assert_almost_equal(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_c_api_ndarray_roundtrip_and_save(amalgamated, tmp_path):
+    """NDArray C surface via ctypes: create/copy/shape/dtype/save/load."""
+    import ctypes
+
+    lib = ctypes.CDLL(os.path.join(amalgamated, "libmxtpu.so"))
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_uint32 * 2)(3, 4)
+    assert lib.MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, ctypes.byref(h)) == 0, \
+        lib.MXGetLastError()
+    data = np.arange(12, dtype=np.float32)
+    assert lib.MXNDArraySyncCopyFromCPU(
+        h, data.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(12)) == 0
+    out = np.zeros(12, np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(12)) == 0
+    np.testing.assert_array_equal(out, data)
+
+    ndim = ctypes.c_uint32()
+    pdata = ctypes.POINTER(ctypes.c_uint32)()
+    assert lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0
+    assert ndim.value == 2 and [pdata[i] for i in range(2)] == [3, 4]
+    dt = ctypes.c_int()
+    assert lib.MXNDArrayGetDType(h, ctypes.byref(dt)) == 0
+    assert dt.value == 0  # float32
+
+    # save with key, load back through the Python side to prove the file
+    # is the reference-binary .params container
+    fname = str(tmp_path / "x.params").encode()
+    keys = (ctypes.c_char_p * 1)(b"weight")
+    arr = (ctypes.c_void_p * 1)(h)
+    assert lib.MXNDArraySave(fname, 1, arr, keys) == 0, lib.MXGetLastError()
+    loaded = mx.nd.load(fname.decode())
+    np.testing.assert_array_equal(loaded["weight"].asnumpy(),
+                                  data.reshape(3, 4))
+    assert lib.MXNDArrayFree(h) == 0
