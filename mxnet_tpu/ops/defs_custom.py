@@ -28,7 +28,10 @@ class _CustomOpDef(OpDef):
         kwargs = {k: v for k, v in params.items() if k != "op_type"}
         return op_mod.make_prop(params["op_type"], kwargs)
 
-    def parse_params(self, raw):
+    def parse_params(self, raw, strict=True):
+        # Custom ops forward ALL plain kwargs to the user's CustomOpProp
+        # (reference custom.cc keeps them opaque), so there is no unknown-key
+        # validation to relax; ``strict`` exists for interface parity.
         if "op_type" not in raw:
             raise MXNetError("Custom op requires op_type")
         return {

@@ -135,12 +135,16 @@ class OpDef:
         return int(self._num_visible_outputs)
 
     # --- params ----------------------------------------------------------
-    def parse_params(self, raw: dict) -> dict:
+    def parse_params(self, raw: dict, strict: bool = True) -> dict:
         """Parse raw attrs (python values or strings) into typed params.
 
         Attribute keys wrapped in double underscores (``__ctx_group__`` etc.)
-        are Symbol-level metadata, not op params, and are skipped. Unknown
-        keys raise, mirroring dmlc::Parameter strictness.
+        are Symbol-level metadata, not op params, and are skipped. With
+        ``strict`` (the op-creation path), unknown keys raise, mirroring
+        dmlc::Parameter strictness on kwargs. Non-strict (node re-parse at
+        execution, legacy JSON loads) ignores them: a node's attrs dict also
+        carries free-form graph attributes — AttrScope user keys, reference
+        attr sections — which the reference keeps outside the param struct.
         """
         out = {}
         for k, spec in self.param_schema.items():
@@ -155,11 +159,12 @@ class OpDef:
                 raise MXNetError(f"op {self.name}: missing required param {k}")
             else:
                 out[k] = spec.default
-        for k in raw:
-            if k not in self.param_schema and not (
-                k.startswith("__") and k.endswith("__")
-            ) and k not in _GRAPH_ATTRS:
-                raise MXNetError(f"op {self.name}: unknown param {k!r}")
+        if strict:
+            for k in raw:
+                if k not in self.param_schema and not (
+                    k.startswith("__") and k.endswith("__")
+                ) and k not in _GRAPH_ATTRS:
+                    raise MXNetError(f"op {self.name}: unknown param {k!r}")
         return out
 
     # --- execution -------------------------------------------------------

@@ -43,7 +43,10 @@ class _Node:
         return self.op is None
 
     def params(self):
-        return self.op.parse_params(self.attrs)
+        # lenient: node attrs also hold free-form graph attributes (AttrScope
+        # user keys, legacy JSON attr sections); strict validation of op
+        # kwargs already happened at creation time (_create)
+        return self.op.parse_params(self.attrs, strict=False)
 
 
 class Symbol:
@@ -499,11 +502,39 @@ load_json = None  # set below
 
 
 def fromjson(json_str):
+    """Deserialize a symbol JSON, including reference-era legacy formats.
+
+    Pre-NNVM JSON (the reference's ``save_000800.json`` fixture, upgraded by
+    ``src/nnvm/legacy_json_util.cc:1-209``) differs from the modern layout:
+    op params live in a separate ``param`` dict next to the free-form
+    ``attr`` section, variable training hints (``lr_mult``/``wd_mult``) are
+    stored bare, and stateful ops (BatchNorm) omit their auxiliary states
+    from ``inputs``. The upgrade below mirrors the reference loader: merge
+    param+attr into node attrs, dunder-wrap the variable hints, and
+    synthesize the missing aux variable inputs with the standard
+    ``{name}_{aux}`` naming so the loaded graph matches one built
+    programmatically.
+    """
     data = json.loads(json_str)
     nodes_js = data["nodes"]
     built = []
+    legacy_ops = []
     for entry in nodes_js:
-        attrs = dict(entry.get("attrs", entry.get("attr", entry.get("param", {}))))
+        legacy = "attrs" not in entry and (
+            "param" in entry or "backward_source_id" in entry
+        )
+        if legacy:
+            attrs = dict(entry.get("param", {}))
+            attrs.update(entry.get("attr", {}))
+            # exact hidden-key match upgrades in place (variable hints);
+            # ctx_group stays plain — this framework's internal convention
+            for hint in _LEGACY_HIDDEN:
+                if hint in attrs:
+                    attrs[f"__{hint}__"] = attrs.pop(hint)
+        else:
+            attrs = dict(
+                entry.get("attrs", entry.get("attr", entry.get("param", {})))
+            )
         is_aux = attrs.pop("__is_aux__", "false") == "true"
         if entry["op"] == "null":
             node = _Node(None, entry["name"], attrs, is_aux=is_aux)
@@ -513,9 +544,52 @@ def fromjson(json_str):
                 (built[i], idx) for (i, idx, *_rest) in entry["inputs"]
             ]
             node = _Node(opdef, entry["name"], attrs, inputs)
+            if not legacy:
+                # typo detection at load time (the reference's attr_parser
+                # runs on load and raises on unknown op params); legacy
+                # nodes instead go through the upgrade passes below
+                opdef.parse_params(attrs, strict=True)
+            if legacy:
+                params = opdef.parse_params(attrs, strict=False)
+                aux_names = opdef.aux_names(params)
+                if aux_names and len(inputs) == len(opdef.arg_names(params)):
+                    for auxn in aux_names:
+                        node.inputs.append((
+                            _Node(None, f"{entry['name']}_{auxn}",
+                                  is_aux=True), 0,
+                        ))
+                legacy_ops.append((node, opdef))
         built.append(node)
+    for node, opdef in legacy_ops:
+        _upgrade_suffixed_hints(node, opdef)
     heads = data.get("heads", [[len(built) - 1, 0, 0]])
     return Symbol([(built[i], idx) for (i, idx, *_r) in heads])
+
+
+# the reference's kHiddenKeys minus ctx_group (c_api_symbolic.cc:20): keys
+# the legacy upgrade pass dunder-wraps (legacy_json_util.cc UpgradeJSON_
+# FixParsing)
+_LEGACY_HIDDEN = ("lr_mult", "wd_mult", "force_mirroring", "mirror_stage")
+
+
+def _upgrade_suffixed_hints(node, opdef):
+    """Old-format ``{argname}_{hint}`` attrs on an op node belong to that
+    named variable input: move e.g. ``weight_lr_mult`` on ``fc1`` to
+    ``__lr_mult__`` on ``fc1_weight`` (legacy_json_util.cc:60-85). The same
+    suffixed key sitting on a *variable* node stays as-is, as the reference
+    leaves it."""
+    params = opdef.parse_params(node.attrs, strict=False)
+    arg_names = list(opdef.arg_names(params))
+    for key in list(node.attrs):
+        for hint in _LEGACY_HIDDEN:
+            suf = "_" + hint
+            if key.endswith(suf) and len(key) > len(suf):
+                prefix = key[: -len(suf)]
+                if prefix in arg_names:
+                    inp = node.inputs[arg_names.index(prefix)][0]
+                    if inp.is_variable:
+                        inp.attrs[f"__{hint}__"] = node.attrs.pop(key)
+                break
 
 
 load_json = fromjson
@@ -534,6 +608,20 @@ def _create(op_name, input_syms, attrs, name=None):
     hint = opdef.name.lower().lstrip("_")
     name = NameManager.current().get(name, hint)
     scope_attrs = AttrScope.current().get({})
+    # reference rule (python/mxnet/symbol.py Variable + test_attr.py:52):
+    # free-form attributes on an OP node must be dunder-wrapped (__mood__);
+    # plain keys are either op params (validated above) or the hidden keys
+    # (ctx_group/lr_mult/...). Variables stay permissive.
+    from .ops.registry import _GRAPH_ATTRS
+
+    for k in scope_attrs:
+        if not (k.startswith("__") and k.endswith("__")) \
+                and k not in _GRAPH_ATTRS and k not in opdef.param_schema:
+            raise ValueError(
+                f"Attribute name={k} is not supported on operator nodes. "
+                "Additional attributes must start and end with double "
+                "underscores, e.g. __yourattr__"
+            )
     node_attrs = dict(scope_attrs)
     node_attrs.update(string_attrs(params_raw))
 

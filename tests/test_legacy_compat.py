@@ -1,0 +1,133 @@
+"""Legacy-format interop against the reference's own fixtures.
+
+Mirrors the reference tests that pin backward compatibility:
+``tests/python/unittest/test_ndarray.py:233`` (test_ndarray_legacy_load —
+the ``legacy_ndarray.v0`` file must load as six arange(128) arrays) and
+``tests/python/unittest/test_symbol.py:154`` (test_load_000800 — the
+pre-NNVM ``save_000800.json`` must load to a symbol equivalent to the
+programmatically-built one, up-converted like
+``src/nnvm/legacy_json_util.cc:1-209`` does).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+_FIXDIR = "/root/reference/tests/python/unittest"
+
+needs_fixtures = pytest.mark.skipif(
+    not os.path.isdir(_FIXDIR), reason="reference fixtures not present"
+)
+
+
+@needs_fixtures
+def test_ndarray_legacy_v0_load():
+    legacy = mx.nd.load(os.path.join(_FIXDIR, "legacy_ndarray.v0"))
+    assert len(legacy) == 6
+    expect = np.arange(128, dtype=np.float32)
+    for arr in legacy:
+        assert arr.shape == (128,)
+        np.testing.assert_array_equal(arr.asnumpy(), expect)
+
+
+def _build_000800():
+    with mx.AttrScope(ctx_group="stage1"):
+        data = mx.sym.Variable("data", lr_mult=0.2)
+        weight = mx.sym.Variable(name="fc1_weight", lr_mult=1.2)
+        fc1 = mx.sym.FullyConnected(data=data, weight=weight, name="fc1",
+                                    num_hidden=128, wd_mult=0.3)
+        act1 = mx.sym.Activation(data=fc1, name="relu1", act_type="relu")
+    with mx.AttrScope(ctx_group="stage2"):
+        fc2 = mx.sym.FullyConnected(data=act1, name="fc2", num_hidden=64,
+                                    lr_mult=0.01)
+        act2 = mx.sym.Activation(data=fc2, name="relu2", act_type="relu")
+        fc3 = mx.sym.FullyConnected(data=act2, name="fc3", num_hidden=10)
+        fc3 = mx.sym.BatchNorm(fc3, name="batchnorm0")
+        sym1 = mx.sym.SoftmaxOutput(data=fc3, name="softmax")
+    return sym1
+
+
+@needs_fixtures
+def test_load_000800_symbol():
+    sym1 = _build_000800()
+    sym2 = mx.sym.load(os.path.join(_FIXDIR, "save_000800.json"))
+
+    # structural parity with the programmatic build (reference
+    # check_symbol_consistency, test_symbol.py:147)
+    assert sym1.list_arguments() == sym2.list_arguments()
+    assert sym1.list_auxiliary_states() == sym2.list_auxiliary_states()
+    assert sym1.list_outputs() == sym2.list_outputs()
+
+    # dunder attrs present in the programmatic build must survive the
+    # legacy load (reference test_load_000800 attr_dict comparison)
+    attr1, attr2 = sym1.attr_dict(), sym2.attr_dict()
+    for k, v1 in attr1.items():
+        for kk, vv1 in v1.items():
+            if kk.startswith("__") and kk.endswith("__"):
+                assert kk in attr2.get(k, {}), (k, kk)
+                assert float(attr2[k][kk]) == float(vv1)
+
+    # numeric consistency: same params -> same forward outputs
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (3, 200)).astype(np.float32)
+    outs = []
+    for sym in (sym1, sym2):
+        exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(3, 200),
+                              softmax_label=(3,))
+        mx.random.seed(5)
+        for name, arr in exe.arg_dict.items():
+            if name not in ("data", "softmax_label"):
+                arr[:] = rng2_init(name, arr.shape)
+        exe.arg_dict["data"][:] = x
+        outs.append(exe.forward(is_train=False)[0].asnumpy())
+    assert_almost_equal(outs[0], outs[1], rtol=1e-5, atol=1e-6)
+
+
+def rng2_init(name, shape):
+    r = np.random.RandomState(abs(hash(name)) % (2**31))
+    return r.uniform(-0.1, 0.1, shape).astype(np.float32)
+
+
+def test_free_form_attr_rules_match_reference():
+    """Reference attr conventions (test_attr.py:50-52 + symbol.py Variable):
+    plain free-form attrs are allowed on VARIABLES; on op nodes they must be
+    dunder-wrapped — a plain unknown key raises; dunder keys ride through
+    execution and JSON round trips without corrupting param parsing."""
+    # plain attrs on a Variable: fine
+    v = mx.sym.Variable("data", attr={"mood": "angry"})
+    assert v.attr_dict()["data"]["mood"] == "angry"
+
+    # dunder attrs on an op node: fine, survive a round trip, still run
+    with mx.AttrScope(__mood__="great"):
+        net = mx.sym.FullyConnected(v, num_hidden=8, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    net = mx.sym.fromjson(net.tojson())
+    assert net.attr_dict()["fc"]["__mood__"] == "great"
+    exe = net.simple_bind(mx.cpu(), grad_req="null", data=(2, 3))
+    exe.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    assert exe.forward()[0].shape == (2, 8)
+
+    # plain unknown key on an op node: rejected like the reference
+    with pytest.raises(ValueError):
+        with mx.AttrScope(mood="great"):
+            mx.sym.FullyConnected(v, num_hidden=8, name="fc_bad")
+
+
+def test_modern_json_load_catches_param_typos():
+    """Loading modern-format JSON validates op params (the reference's
+    attr_parser runs on load): a misspelled optional param raises instead
+    of silently running with the default."""
+    import json
+
+    net = mx.sym.Activation(mx.sym.Variable("data"), act_type="tanh",
+                            name="act")
+    blob = json.loads(net.tojson())
+    for node in blob["nodes"]:
+        if node["name"] == "act":
+            node["attrs"]["act_typ"] = node["attrs"].pop("act_type")
+    with pytest.raises(mx.base.MXNetError):
+        mx.sym.fromjson(json.dumps(blob))
