@@ -24,8 +24,9 @@ def main():
     on_tpu = jax.devices()[0].platform != "cpu"
     batch_size = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16" if on_tpu else "float32")
-    warmup = 3
-    iters = int(os.environ.get("BENCH_ITERS", 20 if on_tpu else 3))
+    warmup = 5 if on_tpu else 2
+    iters = int(os.environ.get("BENCH_ITERS", 25 if on_tpu else 3))
+    windows = max(1, int(os.environ.get("BENCH_WINDOWS", 4 if on_tpu else 1)))
     num_layers = int(os.environ.get("BENCH_LAYERS", 50))
     image = (3, 224, 224) if on_tpu else (3, 64, 64)
 
@@ -66,13 +67,19 @@ def main():
         step()
     fence()
 
-    tic = time.time()
-    for _ in range(iters):
-        step()
-    fence()
-    elapsed = time.time() - tic
-
-    img_per_sec = batch_size * iters / elapsed
+    # several independently-timed windows: the reported value is the
+    # median window, and the spread (max-min)/median is emitted so a
+    # noisy tunnel/host can't silently swing the headline number
+    rates = []
+    for _ in range(windows):
+        tic = time.time()
+        for _ in range(iters):
+            step()
+        fence()
+        rates.append(batch_size * iters / (time.time() - tic))
+    rates.sort()
+    img_per_sec = rates[len(rates) // 2] if windows > 1 else rates[0]
+    spread = (rates[-1] - rates[0]) / img_per_sec if windows > 1 else 0.0
     baseline = 181.53  # reference P100 ResNet-50 train img/s @bs32
     record = {
         "metric": f"resnet{num_layers}_train_throughput"
@@ -80,6 +87,7 @@ def main():
         "value": round(img_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / baseline, 3),
+        "spread": round(spread, 4),
     }
     if on_tpu and num_layers == 50 and dtype == "bfloat16":
         # MFU note: ResNet-50@224 train ≈ 3x fwd FLOPs ≈ 12.3 GFLOP/img.

@@ -81,7 +81,11 @@ def _tpu_compiler_options(ctx):
     compiler; CPU-targeted executors get none.
     """
     try:
-        if ctx.jax_device().platform == "cpu":
+        dev = ctx.jax_device()
+        is_tpu = dev.platform == "tpu" or "TPU" in getattr(
+            dev, "device_kind", ""
+        )  # tunneled TPU plugins report their own platform name
+        if not is_tpu:
             return None
     except Exception:
         return None
@@ -939,7 +943,7 @@ class Executor:
     @staticmethod
     def simple_bind(symbol, ctx, grad_req="write", type_dict=None,
                     group2ctx=None, shared_exec=None, in_shardings=None,
-                    master_params=None, **kwargs):
+                    master_params=None, _inferred_shapes=None, **kwargs):
         """Infer shapes/dtypes and allocate all arrays (reference
         ``GraphExecutor::Init`` simple_bind path, graph_executor.cc:852).
 
@@ -947,8 +951,14 @@ class Executor:
         names (the Module binder passes its parameter list so data-derived
         extra inputs like RNN begin states keep their inferred dtype); None
         applies it to every argument not explicitly typed.
+        ``_inferred_shapes`` lets a caller that already ran infer_shape on
+        the same kwargs (the TP-annotated executor-group bind) hand the
+        result over instead of paying a second full inference.
         """
-        arg_shapes, _out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+        arg_shapes, _out_shapes, aux_shapes = (
+            _inferred_shapes if _inferred_shapes is not None
+            else symbol.infer_shape(**kwargs)
+        )
         type_dict = dict(type_dict or {})
         arg_dtypes, _out_dtypes, aux_dtypes = symbol.infer_type(**type_dict)
         arg_names = symbol.list_arguments()

@@ -17,6 +17,7 @@ from .alexnet import get_symbol as alexnet
 from .googlenet import get_symbol as googlenet
 from .inception_v3 import get_symbol as inception_v3
 from .resnext import get_symbol as resnext
+from .inception_resnet_v2 import get_symbol as inception_resnet_v2
 from .dcgan import make_generator as dcgan_generator
 from .dcgan import make_discriminator as dcgan_discriminator
 from .lstm_lm import lstm_lm_sym_gen

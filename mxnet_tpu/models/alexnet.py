@@ -2,10 +2,12 @@
 the single-tower variant used for the reference's throughput baselines)."""
 
 from .. import symbol as sym
+from .recipe import low_precision_io
 
 
-def get_symbol(num_classes=1000, **kwargs):
+def get_symbol(num_classes=1000, dtype="float32", **kwargs):
     data = sym.Variable("data")
+    data = low_precision_io(data, dtype)
 
     def conv_relu(x, name, num_filter, kernel, stride=(1, 1), pad=(0, 0)):
         x = sym.Convolution(x, num_filter=num_filter, kernel=kernel,
@@ -30,5 +32,6 @@ def get_symbol(num_classes=1000, **kwargs):
         x = sym.FullyConnected(x, num_hidden=4096, name=f"fc{i}")
         x = sym.Activation(x, act_type="relu", name=f"relu{i}")
         x = sym.Dropout(x, p=0.5, name=f"drop{i}")
+    x = low_precision_io(x, dtype, out=True)
     x = sym.FullyConnected(x, num_hidden=num_classes, name=f"fc8")
     return sym.SoftmaxOutput(x, name="softmax")

@@ -1,0 +1,129 @@
+"""Inception-ResNet-v2 (reference
+``example/image-classification/symbols/inception-resnet-v2.py``; the
+"Inception-v4, Inception-ResNet..." architecture, 299x299 input).
+
+Structure: stem -> mixed-5b concat (320ch) -> 10x residual block35
+(scale .17) -> reduction-A (1088ch) -> 20x block17 (scale .1) ->
+reduction-B (2080ch) -> 9x block8 (scale .2) + 1 linear block8 ->
+1536ch 1x1 -> global pool -> dropout -> FC -> softmax. Channel counts
+follow the reference file exactly — including its 129-channel (not 128)
+block17 tower and (1,2)/(2,1) asymmetric pads, kept so checkpoints and
+parameter shapes line up.
+
+Residual scaling (``net + scale * tower``) is plain symbol arithmetic;
+XLA fuses it into the tower's last conv epilogue on TPU.
+"""
+
+from .. import symbol as sym
+from .recipe import low_precision_io
+
+
+def _cb(x, num_filter, kernel, stride=(1, 1), pad=(0, 0), act=True,
+        name=None):
+    x = sym.Convolution(x, num_filter=num_filter, kernel=kernel,
+                        stride=stride, pad=pad, no_bias=True,
+                        name=f"{name}_conv")
+    x = sym.BatchNorm(x, fix_gamma=True, eps=2e-5, name=f"{name}_bn")
+    if act:
+        x = sym.Activation(x, act_type="relu", name=f"{name}_relu")
+    return x
+
+
+def _block35(x, name, scale=0.17, act=True):
+    b0 = _cb(x, 32, (1, 1), name=f"{name}_b0")
+    b1 = _cb(x, 32, (1, 1), name=f"{name}_b1a")
+    b1 = _cb(b1, 32, (3, 3), pad=(1, 1), name=f"{name}_b1b")
+    b2 = _cb(x, 32, (1, 1), name=f"{name}_b2a")
+    b2 = _cb(b2, 48, (3, 3), pad=(1, 1), name=f"{name}_b2b")
+    b2 = _cb(b2, 64, (3, 3), pad=(1, 1), name=f"{name}_b2c")
+    mixed = sym.Concat(b0, b1, b2, dim=1, name=f"{name}_mixed")
+    up = _cb(mixed, 320, (1, 1), act=False, name=f"{name}_up")
+    out = x + scale * up
+    return sym.Activation(out, act_type="relu") if act else out
+
+
+def _block17(x, name, scale=0.1, act=True):
+    b0 = _cb(x, 192, (1, 1), name=f"{name}_b0")
+    # 129 channels and the (1,2)/(2,1) pads are the reference's own numbers
+    b1 = _cb(x, 129, (1, 1), name=f"{name}_b1a")
+    b1 = _cb(b1, 160, (1, 7), pad=(1, 2), name=f"{name}_b1b")
+    b1 = _cb(b1, 192, (7, 1), pad=(2, 1), name=f"{name}_b1c")
+    mixed = sym.Concat(b0, b1, dim=1, name=f"{name}_mixed")
+    up = _cb(mixed, 1088, (1, 1), act=False, name=f"{name}_up")
+    out = x + scale * up
+    return sym.Activation(out, act_type="relu") if act else out
+
+
+def _block8(x, name, scale=0.2, act=True):
+    b0 = _cb(x, 192, (1, 1), name=f"{name}_b0")
+    b1 = _cb(x, 192, (1, 1), name=f"{name}_b1a")
+    b1 = _cb(b1, 224, (1, 3), pad=(0, 1), name=f"{name}_b1b")
+    b1 = _cb(b1, 256, (3, 1), pad=(1, 0), name=f"{name}_b1c")
+    mixed = sym.Concat(b0, b1, dim=1, name=f"{name}_mixed")
+    up = _cb(mixed, 2080, (1, 1), act=False, name=f"{name}_up")
+    out = x + scale * up
+    return sym.Activation(out, act_type="relu") if act else out
+
+
+def get_symbol(num_classes=1000, dtype="float32", **kwargs):
+    data = sym.Variable("data")
+    data = low_precision_io(data, dtype)
+
+    # stem
+    x = _cb(data, 32, (3, 3), stride=(2, 2), name="stem1a")
+    x = _cb(x, 32, (3, 3), name="stem2a")
+    x = _cb(x, 64, (3, 3), pad=(1, 1), name="stem2b")
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = _cb(x, 80, (1, 1), name="stem3b")
+    x = _cb(x, 192, (3, 3), name="stem4a")
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+
+    # mixed 5b -> 320 channels
+    b0 = _cb(x, 96, (1, 1), name="m5b_b0")
+    b1 = _cb(x, 48, (1, 1), name="m5b_b1a")
+    b1 = _cb(b1, 64, (5, 5), pad=(2, 2), name="m5b_b1b")
+    b2 = _cb(x, 64, (1, 1), name="m5b_b2a")
+    b2 = _cb(b2, 96, (3, 3), pad=(1, 1), name="m5b_b2b")
+    b2 = _cb(b2, 96, (3, 3), pad=(1, 1), name="m5b_b2c")
+    b3 = sym.Pooling(x, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                     pool_type="avg")
+    b3 = _cb(b3, 64, (1, 1), name="m5b_b3")
+    x = sym.Concat(b0, b1, b2, b3, dim=1, name="mixed_5b")
+
+    for i in range(10):
+        x = _block35(x, f"b35_{i}")
+
+    # reduction A -> 1088 channels
+    r0 = _cb(x, 384, (3, 3), stride=(2, 2), name="redA_b0")
+    r1 = _cb(x, 256, (1, 1), name="redA_b1a")
+    r1 = _cb(r1, 256, (3, 3), pad=(1, 1), name="redA_b1b")
+    r1 = _cb(r1, 384, (3, 3), stride=(2, 2), name="redA_b1c")
+    r2 = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = sym.Concat(r0, r1, r2, dim=1, name="reduction_a")
+
+    for i in range(20):
+        x = _block17(x, f"b17_{i}")
+
+    # reduction B -> 2080 channels
+    r0 = _cb(x, 256, (1, 1), name="redB_b0a")
+    r0 = _cb(r0, 384, (3, 3), stride=(2, 2), name="redB_b0b")
+    r1 = _cb(x, 256, (1, 1), name="redB_b1a")
+    r1 = _cb(r1, 288, (3, 3), stride=(2, 2), name="redB_b1b")
+    r2 = _cb(x, 256, (1, 1), name="redB_b2a")
+    r2 = _cb(r2, 288, (3, 3), pad=(1, 1), name="redB_b2b")
+    r2 = _cb(r2, 320, (3, 3), stride=(2, 2), name="redB_b2c")
+    r3 = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = sym.Concat(r0, r1, r2, r3, dim=1, name="reduction_b")
+
+    for i in range(9):
+        x = _block8(x, f"b8_{i}")
+    x = _block8(x, "b8_final", act=False)
+
+    x = _cb(x, 1536, (1, 1), name="head")
+    x = sym.Pooling(x, kernel=(1, 1), global_pool=True, pool_type="avg",
+                    name="global_pool")
+    x = sym.Flatten(x)
+    x = sym.Dropout(x, p=0.2)
+    x = low_precision_io(x, dtype, out=True)
+    x = sym.FullyConnected(x, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(x, name="softmax")

@@ -1,0 +1,20 @@
+"""Shared low-precision training recipe for the imagenet symbols.
+
+Reference: the explicit fp16 symbol variants
+(``example/image-classification/symbols/resnet_fp16.py`` /
+``alexnet_fp16.py``) cast the input to fp16 right after the data variable
+and cast back to fp32 before the classifier so the softmax/loss runs in
+full precision. The TPU recipe is identical with bfloat16: the conv trunk
+runs bf16 on the MXU, master weights stay f32 (the executor's master-dtype
+rule), and the head computes in f32.
+"""
+
+from .. import symbol as sym
+
+
+def low_precision_io(x, dtype, out=False):
+    """Cast into the low-precision trunk (``out=False``, after data) or
+    back to f32 for the classifier head (``out=True``). No-op for f32."""
+    if dtype in (None, "float32"):
+        return x
+    return sym.Cast(x, dtype="float32" if out else dtype)

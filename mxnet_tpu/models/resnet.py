@@ -12,6 +12,7 @@ ops/defs_nn.py).
 """
 
 from .. import symbol as sym
+from .recipe import low_precision_io
 
 
 def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True,
@@ -65,11 +66,12 @@ def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True,
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, bn_mom=0.9, memonger=False):
+           bottle_neck=True, bn_mom=0.9, memonger=False, dtype="float32"):
     num_unit = len(units)
     assert num_unit == num_stages
     data = sym.Variable(name="data")
     data = sym.identity(data, name="id")
+    data = low_precision_io(data, dtype)
     (nchannel, height, width) = image_shape
     if height <= 32:  # cifar-style stem
         body = sym.Convolution(data, num_filter=filter_list[0], kernel=(3, 3),
@@ -104,6 +106,7 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
     pool1 = sym.Pooling(relu1, global_pool=True, kernel=(7, 7),
                         pool_type="avg", name="pool1")
     flat = sym.Flatten(pool1)
+    flat = low_precision_io(flat, dtype, out=True)
     fc1 = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
     return sym.SoftmaxOutput(fc1, name="softmax")
 

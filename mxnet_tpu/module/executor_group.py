@@ -150,6 +150,7 @@ class DataParallelExecutorGroup:
         type_kwargs.update({d.name: d.dtype for d in self.label_shapes})
 
         in_shardings = {}
+        inferred = None
         if self._mesh is not None:
             from ..parallel.tensor_parallel import (
                 collect_shard_specs,
@@ -157,12 +158,12 @@ class DataParallelExecutorGroup:
             )
 
             specs = collect_shard_specs(self.symbol)
-            arg_shape = (
-                # only TP-annotated graphs pay for the extra shape inference
-                dict(zip(self.arg_names,
-                         self.symbol.infer_shape(**shape_kwargs)[0]))
-                if any(n in specs for n in self.param_names) else {}
-            )
+            arg_shape = {}
+            if any(n in specs for n in self.param_names):
+                # inference result is handed down to simple_bind so the
+                # graph is walked once, not twice
+                inferred = self.symbol.infer_shape(**shape_kwargs)
+                arg_shape = dict(zip(self.arg_names, inferred[0]))
             for n in self.data_names + self.label_names:
                 in_shardings[n] = self._data_sharding
             for n in self.arg_names:
@@ -185,6 +186,7 @@ class DataParallelExecutorGroup:
             shared_exec=shared_exec,
             in_shardings=in_shardings,
             master_params=self.param_names,
+            _inferred_shapes=inferred,
             **shape_kwargs,
         )
         if self._mesh is not None:

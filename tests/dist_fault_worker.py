@@ -1,0 +1,78 @@
+"""Worker for the fault-injection distributed training test.
+
+Reference analogue: ps-lite's scheduler notices a dead node
+(``src/kvstore/kvstore_dist.h:177-185``) and restarted servers rejoin via
+``is_recovery``. Here recovery is the launcher's whole-job restart
+(tools/launch.py --max-restarts): on the FIRST attempt rank 1 hard-crashes
+mid-epoch (os._exit — no cleanup, like a real kill), the supervisor tears
+the job down and relaunches all ranks, and the second attempt must train to
+convergence with ``kv.num_dead_node`` reporting the recovery.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    attempt = int(os.environ.get("MXNET_NUM_RESTARTS", "0"))
+
+    rng = np.random.RandomState(42)
+    X = rng.randn(128, 10).astype(np.float32)
+    W = rng.randn(10, 4).astype(np.float32)
+    Y = X.dot(W).argmax(1).astype(np.float32)
+    Xs, Ys = X[rank::nw], Y[rank::nw]
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=16, name="fc1"),
+        act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=4, name="fc2"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(Xs, Ys, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mx.random.seed(7)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(
+        kvstore=kv, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.2, "rescale_grad": 1.0 / nw},
+    )
+    metric = mx.metric.Accuracy()
+    step = 0
+    for epoch in range(25):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+            step += 1
+            if attempt == 0 and rank == 1 and epoch == 3:
+                # simulate a mid-training machine death: no cleanup, no
+                # barrier — surviving ranks are left inside the job
+                print(f"rank {rank} CRASHING at epoch {epoch}", flush=True)
+                os._exit(17)
+    acc = metric.get()[1]
+    assert acc > 0.8, f"rank {rank}: post-recovery training stuck at {acc}"
+    assert kv.num_dead_node == 1, (
+        f"rank {rank}: num_dead_node={kv.num_dead_node}, expected the one "
+        "recovered death"
+    )
+    kv.barrier()
+    print(f"rank {rank}/{nw} FAULT-RECOVERY OK acc={acc:.3f} "
+          f"dead={kv.num_dead_node}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

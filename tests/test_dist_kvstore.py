@@ -108,3 +108,32 @@ def test_dist_sync_kvstore_local_processes(nproc):
     assert proc.returncode == 0, f"dist job failed:\n{out[-4000:]}"
     for r in range(nproc):
         assert f"rank {r}/{nproc} DIST OK" in out, out[-4000:]
+
+
+def test_mid_training_worker_kill_recovers_and_converges():
+    """Fault injection at FULL depth: rank 1 hard-dies (os._exit, no
+    cleanup) in the middle of epoch 3 of a real dist_sync training run —
+    the survivors are mid-collective — and the launcher's whole-job
+    restart must bring the job back to convergence, with
+    kv.num_dead_node reporting the recovered death on every rank
+    (reference: ps-lite dead-node detection + is_recovery,
+    src/kvstore/kvstore_dist.h:177-195)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+        "-n", "2", "--launcher", "local", "--port", str(_free_port()),
+        "--max-restarts", "2",
+        sys.executable, os.path.join(_ROOT, "tests", "dist_fault_worker.py"),
+    ]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"fault recovery failed:\n{out[-4000:]}"
+    assert "rank 1 CRASHING at epoch 3" in out, out[-4000:]
+    assert "whole-job restart 1/2" in out, out[-4000:]
+    for r in range(2):
+        assert f"rank {r}/2 FAULT-RECOVERY OK" in out, out[-4000:]
+    assert "dead=1" in out, out[-4000:]

@@ -26,6 +26,8 @@ _CASES = [
     ("resnext-50", lambda: models.resnext(num_classes=10, num_layers=50),
      (2, 3, 224, 224)),
     ("vgg-16", lambda: models.vgg(num_classes=10), (2, 3, 224, 224)),
+    ("inception-resnet-v2",
+     lambda: models.inception_resnet_v2(num_classes=10), (2, 3, 299, 299)),
 ]
 
 
@@ -48,3 +50,49 @@ def test_model_builds_and_forwards(name, factory, dshape):
     assert out.shape == (dshape[0], 10)
     assert np.allclose(out.sum(axis=1), 1.0, atol=1e-3), "not a softmax"
     assert np.isfinite(out).all()
+
+
+def test_inception_resnet_v2_reference_channel_plan():
+    """The stage widths must match the reference file's exact plan
+    (including its 129-channel block17 tower): mixed_5b=320,
+    reduction_a=1088, reduction_b=2080, head=1536."""
+    net = models.inception_resnet_v2(num_classes=10)
+    internals = net.get_internals()
+    shapes = {}
+    for name in ("mixed_5b", "reduction_a", "reduction_b"):
+        s_out = internals[name + "_output"]
+        _, out, _ = s_out.infer_shape(data=(1, 3, 299, 299))
+        shapes[name] = out[0]
+    assert shapes["mixed_5b"][1] == 320
+    assert shapes["reduction_a"][1] == 1088
+    assert shapes["reduction_b"][1] == 2080
+
+
+@pytest.mark.parametrize("factory,dshape", [
+    (lambda dt: models.resnet(num_classes=10, num_layers=18,
+                              image_shape="3,64,64", dtype=dt),
+     (2, 3, 64, 64)),
+    (lambda dt: models.alexnet(num_classes=10, dtype=dt), (2, 3, 224, 224)),
+], ids=["resnet18-bf16", "alexnet-bf16"])
+def test_bf16_recipe_eval_numerics(factory, dshape):
+    """The bfloat16 recipe (reference resnet_fp16/alexnet_fp16 analogue):
+    same params, trunk cast to bf16, classifier in f32 — eval outputs must
+    track the f32 symbol within bf16 tolerance and still be a softmax."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(*dshape).astype(np.float32)
+    outs = {}
+    for dt in ("float32", "bfloat16"):
+        net = factory(dt)
+        exe = net.simple_bind(mx.cpu(), grad_req="null", data=dshape,
+                              softmax_label=(dshape[0],))
+        r = np.random.RandomState(1)
+        for n, arr in exe.arg_dict.items():
+            if n not in ("data", "softmax_label"):
+                arr[:] = mx.nd.array(
+                    r.uniform(-0.05, 0.05, arr.shape).astype(np.float32))
+        exe.arg_dict["data"][:] = mx.nd.array(x)
+        outs[dt] = exe.forward(is_train=False)[0].asnumpy()
+    assert np.allclose(outs["bfloat16"].sum(axis=1), 1.0, atol=1e-2)
+    # bf16 trunk: ~3 decimal digits; logits differences are modest
+    assert np.abs(outs["bfloat16"] - outs["float32"]).max() < 0.1
+    assert np.abs(outs["bfloat16"] - outs["float32"]).mean() < 0.02
