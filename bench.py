@@ -77,8 +77,10 @@ def main():
             step()
         fence()
         rates.append(batch_size * iters / (time.time() - tic))
+    import statistics
+
     rates.sort()
-    img_per_sec = rates[len(rates) // 2] if windows > 1 else rates[0]
+    img_per_sec = statistics.median(rates)
     spread = (rates[-1] - rates[0]) / img_per_sec if windows > 1 else 0.0
     baseline = 181.53  # reference P100 ResNet-50 train img/s @bs32
     record = {

@@ -16,6 +16,11 @@ from .mesh import (
     shard_batch,
     with_mesh,
 )
+from .pipeline_parallel import (
+    microbatch,
+    pipeline_apply,
+    stack_stage_params,
+)
 from .ring_attention import ring_attention, sequence_parallel_sharding
 from .tensor_parallel import (
     collect_shard_specs,

@@ -1,0 +1,128 @@
+"""Pipeline (inter-op, scheduled microbatch) parallelism.
+
+NEW surface beyond reference parity: the reference's closest artifacts are
+generic ``group2ctx`` placement (no schedule; ``graph_executor.cc:286-385``)
+and layer-by-layer ``PartialForward`` — SURVEY.md §2.5 marks scheduled
+pipelining absent. The TPU-native design is the scaling-book recipe: lay
+the stages over a ``pp`` mesh axis and run a GPipe-style microbatch
+schedule as ONE jitted SPMD program — a ``lax.scan`` over pipeline ticks
+whose per-tick body computes each device's stage and hands the activation
+to the next stage with ``lax.ppermute`` over ICI. Because the schedule is
+ordinary traced code, ``jax.grad`` differentiates straight through it
+(``ppermute``'s transpose is the reverse permute), so forward AND backward
+pipeline without a hand-written 1F1B interpreter; XLA overlaps the
+permute DMAs with stage compute.
+
+Constraints of the prototype (documented, enforced):
+
+* stages are homogeneous — one ``stage_fn`` applied with per-stage
+  parameters stacked on a leading axis (transformer-block stacks, the
+  workload pipeline parallelism exists for). Heterogeneous
+  ``SequentialModule`` stages still map to ``ctx_group`` placement.
+* activations keep one shape across stages (d_model in = d_model out).
+* the classic GPipe bubble applies: S + M - 1 ticks for M microbatches
+  over S stages; fill/drain ticks compute on zeros and their results are
+  masked out of the collected output.
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh, pp_axis="pp"):
+    """Run ``x`` through ``S`` pipelined stages of ``stage_fn``.
+
+    Parameters
+    ----------
+    stage_fn : (params_slice, activation) -> activation, traceable; applied
+        per stage with that stage's parameter slice.
+    stage_params : pytree whose leaves have leading axis S (== the pp mesh
+        axis size); stage ``i``'s parameters live on pipeline rank ``i``.
+    x : (num_microbatches, microbatch, ...) input, replicated.
+    mesh : jax Mesh containing ``pp_axis``.
+
+    Returns the (num_microbatches, microbatch, ...) output of the last
+    stage, replicated over the pp axis (the closing broadcast rides the
+    same ring). Differentiable end to end.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    if pp_axis not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {pp_axis!r}")
+    S = mesh.shape[pp_axis]
+    M = int(x.shape[0])
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    for leaf in leaves:
+        if leaf.shape[0] != S:
+            raise MXNetError(
+                f"stage_params leading axis {leaf.shape[0]} != pipeline "
+                f"degree {S}"
+            )
+
+    fwd_ring = [(i, (i + 1) % S) for i in range(S)]
+
+    def run(params, xs):
+        s = jax.lax.axis_index(pp_axis)
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        zero = jnp.zeros_like(xs[0])
+        outs0 = jnp.zeros_like(xs)
+
+        def tick(carry, t):
+            buf, outs = carry
+            # stage 0 ingests microbatch t (clamped during drain); others
+            # consume what the previous stage permuted in last tick
+            feed = xs[jnp.clip(t, 0, M - 1)]
+            a_in = jnp.where(s == 0, feed, buf)
+            y = stage_fn(local, a_in)
+            # the last stage owns microbatch t-(S-1) at tick t
+            out_idx = t - (S - 1)
+            valid = (s == S - 1) & (out_idx >= 0)
+            written = outs.at[jnp.clip(out_idx, 0, M - 1)].set(y)
+            outs = jnp.where(valid, written, outs)
+            nxt = jax.lax.ppermute(y, pp_axis, fwd_ring)
+            return (nxt, outs), None
+
+        (_, outs), _ = jax.lax.scan(
+            tick, (zero, outs0), jnp.arange(M + S - 1)
+        )
+        # replicate the last stage's collected outputs around the ring so
+        # every pipeline rank returns the result (psum of the one non-zero
+        # contribution — outs is zero elsewhere)
+        return jax.lax.psum(outs, pp_axis)
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(pp_axis), stage_params)
+    return jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(spec_params, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stage_params, x)
+
+
+def stack_stage_params(per_stage):
+    """Stack a list of per-stage parameter pytrees (same structure/shapes)
+    into the leading-axis layout ``pipeline_apply`` consumes."""
+    import jax
+    import jax.numpy as jnp
+
+    if not per_stage:
+        raise MXNetError("no stages given")
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage
+    )
+
+
+def microbatch(x, num_microbatches):
+    """Split a global batch (B, ...) into (M, B/M, ...) microbatches."""
+    import jax.numpy as jnp
+
+    B = x.shape[0]
+    if B % num_microbatches != 0:
+        raise MXNetError(
+            f"batch {B} not divisible by {num_microbatches} microbatches"
+        )
+    return jnp.reshape(x, (num_microbatches, B // num_microbatches)
+                       + tuple(x.shape[1:]))

@@ -10,7 +10,6 @@ convergence with ``kv.num_dead_node`` reporting the recovery.
 """
 
 import os
-import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 

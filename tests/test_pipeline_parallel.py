@@ -1,0 +1,94 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a pp mesh axis.
+
+Beyond-reference surface (SURVEY.md §2.5 marks scheduled pipelining absent
+there); the oracle is serial equivalence — the pipelined program must equal
+running the stage stack sequentially, for outputs AND gradients.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.test_utils import assert_almost_equal
+
+import jax
+import jax.numpy as jnp
+
+
+def _stage(params, a):
+    w, b = params
+    return jnp.tanh(a @ w + b)
+
+
+def _serial(stage_params, x):
+    # x: (M, mb, d); apply stages sequentially
+    S = stage_params[0].shape[0]
+    y = x
+    for s in range(S):
+        y = _stage((stage_params[0][s], stage_params[1][s]), y)
+    return y
+
+
+@pytest.mark.parametrize("S,M", [(4, 8), (2, 2)])
+def test_pipeline_matches_serial_forward_and_grad(S, M):
+    mesh = parallel.make_mesh({"pp": S})
+    d, mb = 16, 4
+    rng = np.random.RandomState(0)
+    ws = jnp.asarray(rng.randn(S, d, d).astype(np.float32) * 0.3)
+    bs = jnp.asarray(rng.randn(S, d).astype(np.float32) * 0.1)
+    x = jnp.asarray(rng.randn(M, mb, d).astype(np.float32))
+
+    out = parallel.pipeline_apply(_stage, (ws, bs), x, mesh)
+    ref = _serial((ws, bs), x)
+    assert_almost_equal(np.asarray(out), np.asarray(ref),
+                        rtol=1e-5, atol=1e-6)
+
+    def loss_pp(ws, bs):
+        return jnp.sum(parallel.pipeline_apply(_stage, (ws, bs), x, mesh) ** 2)
+
+    def loss_serial(ws, bs):
+        return jnp.sum(_serial((ws, bs), x) ** 2)
+
+    g_pp = jax.grad(loss_pp, argnums=(0, 1))(ws, bs)
+    g_ref = jax.grad(loss_serial, argnums=(0, 1))(ws, bs)
+    for a, b in zip(g_pp, g_ref):
+        assert_almost_equal(np.asarray(a), np.asarray(b),
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_jits_and_trains():
+    """One jitted train step over the pipeline: params move, loss falls."""
+    S, M, d, mb = 4, 4, 8, 8
+    mesh = parallel.make_mesh({"pp": S})
+    rng = np.random.RandomState(1)
+    ws = jnp.asarray(rng.randn(S, d, d).astype(np.float32) * 0.3)
+    bs = jnp.zeros((S, d), jnp.float32)
+    x = jnp.asarray(rng.randn(M, mb, d).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(M, mb, d).astype(np.float32))
+
+    @jax.jit
+    def step(ws, bs):
+        def loss(ws, bs):
+            y = parallel.pipeline_apply(_stage, (ws, bs), x, mesh)
+            return jnp.mean((y - tgt) ** 2)
+
+        l, g = jax.value_and_grad(loss, argnums=(0, 1))(ws, bs)
+        return l, ws - 0.1 * g[0], bs - 0.1 * g[1]
+
+    losses = []
+    for _ in range(20):
+        l, ws, bs = step(ws, bs)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.9, losses[:3] + losses[-3:]
+
+
+def test_microbatch_helpers():
+    x = jnp.arange(24.0).reshape(12, 2)
+    m = parallel.microbatch(x, 4)
+    assert m.shape == (4, 3, 2)
+    with pytest.raises(mx.base.MXNetError):
+        parallel.microbatch(x, 5)
+    stages = [(jnp.ones((2, 2)), jnp.zeros(2)) for _ in range(3)]
+    stacked = parallel.stack_stage_params(stages)
+    assert stacked[0].shape == (3, 2, 2) and stacked[1].shape == (3, 2)
