@@ -200,3 +200,45 @@ def list_all_op_names():
     from .ops import registry
 
     return sorted(registry._OPS.keys())
+
+
+def imperative_invoke(op_name, inputs, keys, vals, out=None):
+    """MXImperativeInvoke: run a registered op eagerly on NDArray inputs
+    with string-valued params (the path binding-generated ``mx.nd.*``
+    functions use in the reference, c_api_ndarray.cc:396-460). With
+    ``out`` (caller-provided output NDArrays, the reference's non-null
+    *outputs contract) results are written in place; otherwise returns
+    fresh output NDArrays."""
+    from . import ndarray
+
+    fn = getattr(ndarray, op_name, None)
+    if fn is None:
+        raise MXNetError(f"no imperative op {op_name!r}")
+    kwargs = dict(zip(keys, vals))
+    if out is not None:
+        kwargs["out"] = out if len(out) > 1 else out[0]
+    res = fn(*inputs, **kwargs)
+    return list(res) if isinstance(res, (list, tuple)) else [res]
+
+
+def nd_reshape(nd, shape):
+    return nd.reshape(tuple(int(s) for s in shape))
+
+
+def nd_slice(nd, start, stop):
+    return nd[int(start):int(stop)]
+
+
+def nd_at(nd, idx):
+    return nd[int(idx)]
+
+
+def sym_get_attr(sym, key):
+    """None means absent; an empty string is a real (empty) value — the C
+    side maps these to success=0/1 like the reference."""
+    return sym.attr(key)
+
+
+def sym_set_attr(sym, key, value):
+    sym._set_attr(**{key: value})
+    return None

@@ -52,6 +52,22 @@ def _fold_rng(rng):
     return jax.random.fold_in(base, step)
 
 
+def _lazy_placeholder(shape, dtype):
+    """An NDArray that reports shape/dtype but allocates device zeros only
+    if read before being written (bucketing reshape placeholders)."""
+    nd = NDArray(None)
+
+    def make():
+        import jax.numpy as jnp
+
+        nd._data = jnp.zeros(shape, np_dtype(dtype))
+
+    make.shape = tuple(shape)
+    make.dtype = np_dtype(dtype)
+    nd._set_lazy(make)
+    return nd
+
+
 def _head_loss_flags(graph):
     """Which graph heads are loss outputs (drive an implicit backward).
 
@@ -908,7 +924,16 @@ class Executor:
                     raise MXNetError(f"Found name {name!r} not in aux states")
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
-        """Return a new executor with new data shapes, sharing parameters."""
+        """Return a new executor with new data shapes, sharing parameters.
+
+        Shape-matched arrays are shared outright. Mismatched entries (the
+        data/label arrays of a new bucket) become LAZY placeholders that
+        allocate only if actually read before being bound — the steady
+        bucketing loop overwrites them with each batch, so N bucket
+        executors don't pin N copies of input/grad buffers in HBM (the
+        reference bounds this with the shared data_pool_,
+        graph_executor.cc:813-817; under XLA the pool is PJRT's allocator,
+        which can only recycle buffers we never create)."""
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
         new_args = {}
         for n, s in zip(self.arg_names, arg_shapes):
@@ -921,11 +946,12 @@ class Executor:
                         f"reshape: shape of {n} changed {cur.shape}->{s}; "
                         "set partial_shaping=True"
                     )
-                new_args[n] = nd_zeros(s, dtype=cur.dtype)
+                new_args[n] = _lazy_placeholder(s, cur.dtype)
         new_grads = {}
         for n, g in self.grad_dict.items():
             s = arg_shapes[self.arg_names.index(n)]
-            new_grads[n] = g if tuple(g.shape) == tuple(s) else nd_zeros(s, dtype=g.dtype)
+            new_grads[n] = g if tuple(g.shape) == tuple(s) else \
+                _lazy_placeholder(s, g.dtype)
         exe = Executor(
             self._symbol,
             self._ctx,

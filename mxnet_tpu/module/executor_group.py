@@ -48,7 +48,19 @@ class DataParallelExecutorGroup:
                  state_names=None, in_shardings=None):
         self.symbol = symbol
         self.contexts = list(contexts)
-        self.workload = workload  # accepted for parity; SPMD shards evenly
+        # accepted for parity; SPMD shards evenly — warn when a caller asks
+        # for an uneven split it will not get (reference decide_slices
+        # weights shards by workload, executor_group.py:216)
+        self.workload = workload
+        if workload and len(set(workload)) > 1:
+            import warnings
+
+            warnings.warn(
+                "non-uniform workload ignored: the SPMD executor shards "
+                "the batch evenly across devices (uneven per-device "
+                "workloads have no benefit on identical TPU cores)",
+                stacklevel=3,
+            )
         self.param_names = param_names
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad

@@ -573,7 +573,37 @@ int MXExecutorFree(ExecutorHandle handle) {
   return 0;
 }
 
-/* ---------------- registry ---------------- */
+/* ---------------- registry + imperative invoke ---------------- */
+
+namespace mxtpu {
+// process-stable op-name table backing AtomicSymbolCreator handles: a
+// creator is (index+1) into this list (the reference hands out nnvm::Op*
+// pointers; an index is the adapter equivalent)
+inline std::vector<std::string>& op_table() {
+  static std::vector<std::string> names;
+  return names;
+}
+
+inline bool ensure_op_table() {
+  if (!op_table().empty()) return true;
+  PyObject* r = capi_call("list_all_op_names", PyTuple_New(0));
+  if (!r) return false;
+  Py_ssize_t n = PySequence_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(r, i);
+    const char* c = it ? PyUnicode_AsUTF8(it) : nullptr;
+    if (!c) {
+      Py_XDECREF(it);
+      Py_DECREF(r);
+      return false;
+    }
+    op_table().emplace_back(c);
+    Py_DECREF(it);
+  }
+  Py_DECREF(r);
+  return true;
+}
+}  // namespace mxtpu
 
 int MXListAllOpNames(uint32_t* out_size, const char*** out_array) {
   MXTPU_API_BEGIN();
@@ -585,6 +615,166 @@ int MXListAllOpNames(uint32_t* out_size, const char*** out_array) {
   if (!ok) break;
   *out_size = (uint32_t)holder.cstrs.size();
   *out_array = holder.cstrs.data();
+  MXTPU_API_END();
+}
+
+int MXSymbolListAtomicSymbolCreators(uint32_t* out_size,
+                                     AtomicSymbolCreator** out_array) {
+  MXTPU_API_BEGIN();
+  if (!mxtpu::ensure_op_table()) break;
+  static thread_local std::vector<AtomicSymbolCreator> creators;
+  creators.clear();
+  for (size_t i = 0; i < mxtpu::op_table().size(); ++i)
+    creators.push_back((AtomicSymbolCreator)(uintptr_t)(i + 1));
+  *out_size = (uint32_t)creators.size();
+  *out_array = creators.data();
+  MXTPU_API_END();
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char** name) {
+  MXTPU_API_BEGIN();
+  if (!mxtpu::ensure_op_table()) break;
+  size_t idx = (size_t)(uintptr_t)creator;
+  if (idx == 0 || idx > mxtpu::op_table().size()) {
+    g_last_error = "invalid AtomicSymbolCreator";
+    return -1;
+  }
+  *name = mxtpu::op_table()[idx - 1].c_str();
+  MXTPU_API_END();
+}
+
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals) {
+  MXTPU_API_BEGIN();
+  if (!mxtpu::ensure_op_table()) break;
+  size_t idx = (size_t)(uintptr_t)creator;
+  if (idx == 0 || idx > mxtpu::op_table().size()) {
+    g_last_error = "invalid AtomicSymbolCreator";
+    return -1;
+  }
+  PyObject* ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    Py_INCREF(H(inputs[i])->obj);
+    PyList_SET_ITEM(ins, i, H(inputs[i])->obj);
+  }
+  PyObject* keys = PyList_New(num_params);
+  PyObject* vals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  // reference contract (c_api_ndarray.cc): a non-null *outputs means the
+  // caller provides *num_outputs arrays to write in place (the out= path)
+  bool caller_out = (*outputs != nullptr && *num_outputs > 0);
+  PyObject* out_l;
+  if (caller_out) {
+    out_l = PyList_New(*num_outputs);
+    for (int i = 0; i < *num_outputs; ++i) {
+      Py_INCREF(H((*outputs)[i])->obj);
+      PyList_SET_ITEM(out_l, i, H((*outputs)[i])->obj);
+    }
+  } else {
+    out_l = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject* r = capi_call(
+      "imperative_invoke",
+      Py_BuildValue("(sNNNN)", mxtpu::op_table()[idx - 1].c_str(), ins, keys,
+                    vals, out_l));
+  if (!r) break;
+  if (caller_out) {
+    // results landed in the caller's arrays; leave their handles alone
+    Py_DECREF(r);
+  } else {
+    static thread_local std::vector<NDArrayHandle> ret_handles;
+    ret_handles.clear();
+    Py_ssize_t n = PySequence_Size(r);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      Handle* h = new Handle();
+      h->obj = PySequence_GetItem(r, i);  // new ref — caller frees
+      ret_handles.push_back(h);
+    }
+    Py_DECREF(r);
+    *num_outputs = (int)n;
+    *outputs = ret_handles.data();
+  }
+  MXTPU_API_END();
+}
+
+/* ---------------- NDArray views ---------------- */
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
+                     NDArrayHandle* out) {
+  MXTPU_API_BEGIN();
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLong(dims[i]));
+  PyObject* r = capi_call(
+      "nd_reshape", Py_BuildValue("(ON)", H(handle)->obj, shp));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXNDArraySlice(NDArrayHandle handle, uint32_t slice_begin,
+                   uint32_t slice_end, NDArrayHandle* out) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(
+      "nd_slice",
+      Py_BuildValue("(OII)", H(handle)->obj, slice_begin, slice_end));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle* out) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("nd_at", Py_BuildValue("(OI)", H(handle)->obj, idx));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+/* ---------------- Symbol attrs ---------------- */
+
+int MXSymbolGetAttr(SymbolHandle symbol, const char* key, const char** out,
+                    int* success) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(
+      "sym_get_attr", Py_BuildValue("(Os)", H(symbol)->obj, key));
+  if (!r) break;
+  if (r == Py_None) {  // absent; an empty string is a real (empty) value
+    Py_DECREF(r);
+    *success = 0;
+    *out = nullptr;
+  } else {
+    const char* c = PyUnicode_AsUTF8(r);
+    if (!c) {
+      Py_DECREF(r);
+      break;
+    }
+    H(symbol)->json = c;  // reuse the per-handle string scratch
+    Py_DECREF(r);
+    *success = 1;
+    *out = H(symbol)->json.c_str();
+  }
+  MXTPU_API_END();
+}
+
+int MXSymbolSetAttr(SymbolHandle symbol, const char* key, const char* value) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(
+      "sym_set_attr", Py_BuildValue("(Oss)", H(symbol)->obj, key, value));
+  Py_XDECREF(r);
   MXTPU_API_END();
 }
 

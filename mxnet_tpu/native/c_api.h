@@ -33,6 +33,7 @@ extern "C" {
 typedef void* NDArrayHandle;
 typedef void* SymbolHandle;
 typedef void* ExecutorHandle;
+typedef void* AtomicSymbolCreator;
 
 const char* MXGetLastError();
 
@@ -105,8 +106,31 @@ int MXExecutorOutputs(ExecutorHandle handle, uint32_t* out_size,
                       NDArrayHandle** out);
 int MXExecutorFree(ExecutorHandle handle);
 
-/* ---------------- registry ---------------- */
+/* ---------------- registry + imperative invoke ---------------- */
 int MXListAllOpNames(uint32_t* out_size, const char*** out_array);
+int MXSymbolListAtomicSymbolCreators(uint32_t* out_size,
+                                     AtomicSymbolCreator** out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char** name);
+/* eager op execution on NDArray handles with string params — the path
+ * binding-generated nd.* functions use (reference c_api_ndarray.cc:396).
+ * Returned output handles are NEW references the caller must free. */
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals);
+
+/* ---------------- NDArray views ---------------- */
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
+                     NDArrayHandle* out);
+int MXNDArraySlice(NDArrayHandle handle, uint32_t slice_begin,
+                   uint32_t slice_end, NDArrayHandle* out);
+int MXNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle* out);
+
+/* ---------------- Symbol attrs ---------------- */
+int MXSymbolGetAttr(SymbolHandle symbol, const char* key, const char** out,
+                    int* success);
+int MXSymbolSetAttr(SymbolHandle symbol, const char* key, const char* value);
 
 #ifdef __cplusplus
 }

@@ -87,6 +87,13 @@ class NDArray:
     # --- basic properties -------------------------------------------------
     @property
     def shape(self):
+        if self._d is None and self._lazy is not None:
+            # lazy handles can carry their metadata on the thunk (see
+            # executor reshape placeholders) so shape/dtype queries don't
+            # force a device allocation
+            s = getattr(self._lazy, "shape", None)
+            if s is not None:
+                return tuple(s)
         return tuple(self._data.shape)
 
     @property
@@ -99,6 +106,10 @@ class NDArray:
 
     @property
     def dtype(self):
+        if self._d is None and self._lazy is not None:
+            dt = getattr(self._lazy, "dtype", None)
+            if dt is not None:
+                return np_dtype(dt)
         return np_dtype(self._data.dtype)
 
     @property

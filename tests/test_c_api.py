@@ -240,3 +240,97 @@ def test_c_api_ndarray_roundtrip_and_save(amalgamated, tmp_path):
     np.testing.assert_array_equal(loaded["weight"].asnumpy(),
                                   data.reshape(3, 4))
     assert lib.MXNDArrayFree(h) == 0
+
+
+def test_c_api_imperative_invoke_and_views(amalgamated, tmp_path):
+    """The imperative tier: creators enumerate the registry, and
+    MXImperativeInvoke runs ops eagerly on NDArray handles (the
+    reference's generated-nd.* foundation, c_api_ndarray.cc:396).
+    Views (Reshape/Slice/At) and symbol attr get/set round-trip."""
+    import ctypes
+
+    lib = ctypes.CDLL(os.path.join(amalgamated, "libmxtpu.so"))
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    # creators <-> names
+    n = ctypes.c_uint32()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXSymbolListAtomicSymbolCreators(
+        ctypes.byref(n), ctypes.byref(creators)) == 0
+    assert n.value >= 200
+    name = ctypes.c_char_p()
+    by_name = {}
+    for i in range(n.value):
+        c = ctypes.c_void_p(creators[i])
+        assert lib.MXSymbolGetAtomicSymbolName(c, ctypes.byref(name)) == 0
+        by_name[name.value.decode()] = ctypes.c_void_p(creators[i])
+    assert "Activation" in by_name and "dot" in by_name
+
+    # x = arange(6)-2 as (2,3); y = relu(x) via imperative invoke
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_uint32 * 2)(2, 3)
+    assert lib.MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, ctypes.byref(h)) == 0
+    data = (np.arange(6, dtype=np.float32) - 2).reshape(2, 3)
+    assert lib.MXNDArraySyncCopyFromCPU(
+        h, data.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(6)) == 0
+
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    keys = (ctypes.c_char_p * 1)(b"act_type")
+    vals = (ctypes.c_char_p * 1)(b"relu")
+    ins = (ctypes.c_void_p * 1)(h)
+    assert lib.MXImperativeInvoke(
+        by_name["Activation"], 1, ins, ctypes.byref(n_out),
+        ctypes.byref(outs), 1, keys, vals) == 0, lib.MXGetLastError()
+    assert n_out.value == 1
+    y = ctypes.c_void_p(outs[0])
+    buf = np.zeros(6, np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(
+        y, buf.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(6)) == 0
+    np.testing.assert_array_equal(buf.reshape(2, 3), np.maximum(data, 0))
+
+    # caller-provided outputs (the reference's non-null *outputs out= path)
+    o = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, ctypes.byref(o)) == 0
+    outs2 = (ctypes.c_void_p * 1)(o)
+    outs2_p = ctypes.cast(outs2, ctypes.POINTER(ctypes.c_void_p))
+    n_out2 = ctypes.c_int(1)
+    assert lib.MXImperativeInvoke(
+        by_name["Activation"], 1, ins, ctypes.byref(n_out2),
+        ctypes.byref(outs2_p), 1, keys, vals) == 0, lib.MXGetLastError()
+    buf2 = np.zeros(6, np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(
+        o, buf2.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(6)) == 0
+    np.testing.assert_array_equal(buf2.reshape(2, 3), np.maximum(data, 0))
+    lib.MXNDArrayFree(o)
+
+    # views: reshape to (3,2), slice rows, index
+    r = ctypes.c_void_p()
+    dims = (ctypes.c_int * 2)(3, 2)
+    assert lib.MXNDArrayReshape(h, 2, dims, ctypes.byref(r)) == 0
+    nd_dim = ctypes.c_uint32()
+    pdata = ctypes.POINTER(ctypes.c_uint32)()
+    assert lib.MXNDArrayGetShape(r, ctypes.byref(nd_dim),
+                                 ctypes.byref(pdata)) == 0
+    assert [pdata[i] for i in range(nd_dim.value)] == [3, 2]
+    s = ctypes.c_void_p()
+    assert lib.MXNDArraySlice(r, 1, 3, ctypes.byref(s)) == 0
+    assert lib.MXNDArrayGetShape(s, ctypes.byref(nd_dim),
+                                 ctypes.byref(pdata)) == 0
+    assert [pdata[i] for i in range(nd_dim.value)] == [2, 2]
+    a = ctypes.c_void_p()
+    assert lib.MXNDArrayAt(s, 0, ctypes.byref(a)) == 0
+
+    # symbol attrs
+    sym = ctypes.c_void_p()
+    js = mx.sym.Variable("w").tojson().encode()
+    assert lib.MXSymbolCreateFromJSON(js, ctypes.byref(sym)) == 0
+    assert lib.MXSymbolSetAttr(sym, b"__mood__", b"great") == 0
+    out_s = ctypes.c_char_p()
+    ok = ctypes.c_int()
+    assert lib.MXSymbolGetAttr(sym, b"__mood__", ctypes.byref(out_s),
+                               ctypes.byref(ok)) == 0
+    assert ok.value == 1 and out_s.value == b"great"
+    for handle in (h, y, r, s, a):
+        lib.MXNDArrayFree(handle)
+    lib.MXSymbolFree(sym)

@@ -138,3 +138,56 @@ def test_debug_str_and_partial_forward():
     np.testing.assert_allclose(
         outs[0].asnumpy(), np.maximum(x.dot(w1.T), 0), rtol=1e-5
     )
+
+
+def test_reshape_uses_lazy_placeholders():
+    """Bucketing-style reshape must not allocate fresh input/grad buffers
+    per bucket: mismatched-shape entries are lazy placeholders that the
+    per-batch bind overwrites without ever materialising (the reference
+    bounds bucket memory with the shared data_pool_,
+    graph_executor.cc:813-817)."""
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc"),
+        name="softmax")
+    exe = net.simple_bind(mx.cpu(), data=(8, 6), softmax_label=(8,))
+    exe.arg_dict["fc_weight"][:] = np.ones((4, 6), np.float32)
+    exe2 = exe.reshape(data=(2, 6), softmax_label=(2,))
+    data2 = exe2.arg_dict["data"]
+    assert data2._d is None, "placeholder materialised eagerly"
+    assert data2.shape == (2, 6)          # metadata without allocation
+    assert str(data2.dtype) == "float32"
+    assert data2._d is None, "shape/dtype query allocated the placeholder"
+    # params are SHARED, not copied
+    assert exe2.arg_dict["fc_weight"]._d is exe.arg_dict["fc_weight"]._d
+    # the normal flow binds fresh data; the placeholder must never fire
+    out = exe2.forward(
+        is_train=False, data=np.ones((2, 6), np.float32),
+        softmax_label=np.zeros(2, np.float32),
+    )[0].asnumpy()
+    assert out.shape == (2, 4)
+    # reading an UNBOUND placeholder still works (materialises zeros)
+    exe3 = exe.reshape(data=(3, 6), softmax_label=(3,))
+    assert np.all(exe3.grad_dict["fc_weight"].asnumpy() == 0) \
+        if exe3.grad_dict.get("fc_weight") is not None else True
+
+
+def test_nonuniform_workload_warns():
+    import warnings
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax")
+    from mxnet_tpu.module.executor_group import DataParallelExecutorGroup
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        DataParallelExecutorGroup(
+            net, [mx.cpu(0), mx.cpu(1)], workload=[1, 3],
+            data_shapes=[("data", (16, 4))],
+            label_shapes=[("softmax_label", (16,))],
+            param_names=[n for n in net.list_arguments()
+                         if n not in ("data", "softmax_label")],
+            for_training=True, inputs_need_grad=False,
+        )
+    assert any("workload" in str(x.message) for x in w), \
+        [str(x.message) for x in w]
