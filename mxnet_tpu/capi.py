@@ -17,6 +17,7 @@ import numpy as np
 
 from .base import MXNetError
 from .context import Context
+from .ndarray import NDArray
 
 # reference mshadow TypeFlag codes (include/mxnet/tensor_blob.h via mshadow);
 # 12 = bfloat16 extension (the TPU-preferred half type; the reference era
@@ -221,16 +222,63 @@ def imperative_invoke(op_name, inputs, keys, vals, out=None):
     return list(res) if isinstance(res, (list, tuple)) else [res]
 
 
+class _NDView(NDArray):
+    """Write-through view handle for the C ABI.
+
+    The reference's MXNDArraySlice/At/Reshape return views SHARING the
+    parent's memory (ndarray.h slicing over the same Chunk): a C client
+    fills a pre-allocated batch row by row through sliced handles. jax
+    arrays are immutable, so the aliasing contract is expressed as a
+    parent-rebinding proxy instead: reads pull the current slice of the
+    parent, writes rebuild the parent around the new values. Works
+    anywhere an NDArray does (all framework code reaches data through the
+    ``_data`` property this class overrides).
+    """
+
+    __slots__ = ("_parent", "_get", "_set")
+
+    def __init__(self, parent, get, set_):
+        super().__init__(None)
+        self._parent = parent
+        self._get = get
+        self._set = set_
+
+    @property
+    def _data(self):
+        return self._get(self._parent._data)
+
+    @_data.setter
+    def _data(self, value):
+        self._parent._data = self._set(self._parent._data, value)
+
+
 def nd_reshape(nd, shape):
-    return nd.reshape(tuple(int(s) for s in shape))
+    from .ops.defs_tensor import infer_reshape
+
+    out = infer_reshape(nd.shape, tuple(int(s) for s in shape), False)
+    return _NDView(
+        nd,
+        lambda d: d.reshape(out),
+        lambda d, v: v.reshape(d.shape),
+    )
 
 
 def nd_slice(nd, start, stop):
-    return nd[int(start):int(stop)]
+    start, stop = int(start), int(stop)
+    return _NDView(
+        nd,
+        lambda d: d[start:stop],
+        lambda d, v: d.at[start:stop].set(v),
+    )
 
 
 def nd_at(nd, idx):
-    return nd[int(idx)]
+    idx = int(idx)
+    return _NDView(
+        nd,
+        lambda d: d[idx],
+        lambda d, v: d.at[idx].set(v),
+    )
 
 
 def sym_get_attr(sym, key):
@@ -242,3 +290,123 @@ def sym_get_attr(sym, key):
 def sym_set_attr(sym, key, value):
     sym._set_attr(**{key: value})
     return None
+
+
+# ---------------- KVStore ----------------
+
+def kv_create(kind):
+    from . import kvstore
+
+    return kvstore.create(kind)
+
+
+def kv_init(kv, keys, nds):
+    kv.init(list(keys), list(nds))
+    return None
+
+
+def kv_push(kv, keys, nds, priority):
+    kv.push(list(keys), list(nds), priority=int(priority))
+    return None
+
+
+def kv_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+    return None
+
+
+def kv_rank(kv):
+    return int(kv.rank)
+
+
+def kv_group_size(kv):
+    return int(kv.num_workers)
+
+
+def kv_type(kv):
+    return str(kv.type)
+
+
+def kv_barrier(kv):
+    kv.barrier()
+    return None
+
+
+# ---------------- RecordIO ----------------
+
+def recordio_open(path, mode):
+    from .recordio import MXRecordIO
+
+    return MXRecordIO(path, mode)
+
+
+def recordio_write(rec, raw):
+    rec.write(raw)
+    return None
+
+
+def recordio_read(rec):
+    """Next record bytes, or None at end of file."""
+    return rec.read()
+
+
+def recordio_close(rec):
+    rec.close()
+    return None
+
+
+# ---------------- DataIter ----------------
+
+_C_ITERS = ("MNISTIter", "CSVIter", "ImageRecordIter", "ImageDetRecordIter",
+            "LibSVMIter")
+
+
+def list_data_iters():
+    return list(_C_ITERS)
+
+
+def dataiter_create(name, keys, vals):
+    """Create a registry iterator from string kwargs (the reference parses
+    them with dmlc::Parameter; here each value is literal-eval'd with a
+    string fallback)."""
+    import ast
+
+    from . import io as io_mod
+
+    if name not in _C_ITERS:
+        raise MXNetError(f"unknown data iter {name!r}")
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        if v in ("true", "false"):  # dmlc wire format for bools
+            kwargs[k] = v == "true"
+            continue
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    return getattr(io_mod, name)(**kwargs)
+
+
+def dataiter_next(it):
+    """Advance; returns the DataBatch or None at epoch end."""
+    try:
+        return next(it)
+    except StopIteration:
+        return None
+
+
+def dataiter_before_first(it):
+    it.reset()
+    return None
+
+
+def batch_data(batch, index):
+    return batch.data[int(index)]
+
+
+def batch_label(batch, index):
+    return batch.label[int(index)]
+
+
+def batch_pad(batch):
+    return int(getattr(batch, "pad", 0) or 0)

@@ -27,6 +27,7 @@ namespace mxtpu {
 // c_api_common.h MXAPIThreadLocalEntry).
 struct Handle {
   PyObject* obj = nullptr;
+  PyObject* obj2 = nullptr;  // secondary (data iters: the current batch)
   std::vector<std::string> strs;
   std::vector<const char*> cstrs;
   std::vector<uint32_t> shape;
@@ -36,9 +37,10 @@ struct Handle {
   std::vector<const uint32_t*> dptrs[3];
   std::string json;
   ~Handle() {
-    if (obj) {
+    if (obj || obj2) {
       GIL gil;
-      Py_DECREF(obj);
+      Py_XDECREF(obj);
+      Py_XDECREF(obj2);
     }
   }
 };
@@ -584,9 +586,15 @@ inline std::vector<std::string>& op_table() {
   return names;
 }
 
-inline bool ensure_op_table() {
-  if (!op_table().empty()) return true;
-  PyObject* r = capi_call("list_all_op_names", PyTuple_New(0));
+// populate a local vector from a python list-of-str call, then publish it
+// under a plain mutex with a second emptiness check. The python call can
+// release the GIL mid-way (another thread's first registry call may
+// interleave), so the critical section holds NO python calls — a mutex
+// around the whole populate would deadlock against the GIL.
+inline bool fill_name_table(const char* fn, std::vector<std::string>& table) {
+  if (!table.empty()) return true;
+  std::vector<std::string> local;
+  PyObject* r = capi_call(fn, PyTuple_New(0));
   if (!r) return false;
   Py_ssize_t n = PySequence_Size(r);
   for (Py_ssize_t i = 0; i < n; ++i) {
@@ -597,11 +605,18 @@ inline bool ensure_op_table() {
       Py_DECREF(r);
       return false;
     }
-    op_table().emplace_back(c);
+    local.emplace_back(c);
     Py_DECREF(it);
   }
   Py_DECREF(r);
+  static std::mutex publish_mu;
+  std::lock_guard<std::mutex> g(publish_mu);
+  if (table.empty()) table = std::move(local);
   return true;
+}
+
+inline bool ensure_op_table() {
+  return fill_name_table("list_all_op_names", op_table());
 }
 }  // namespace mxtpu
 
@@ -775,6 +790,320 @@ int MXSymbolSetAttr(SymbolHandle symbol, const char* key, const char* value) {
   PyObject* r = capi_call(
       "sym_set_attr", Py_BuildValue("(Oss)", H(symbol)->obj, key, value));
   Py_XDECREF(r);
+  MXTPU_API_END();
+}
+
+/* ---------------- KVStore ---------------- */
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("kv_create", Py_BuildValue("(s)", type));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  ensure_python();
+  delete H(handle);
+  return 0;
+}
+
+// build the (keys, vals) python lists for a KVStore call (caller owns refs)
+static void kv_keys_vals(const int* keys, NDArrayHandle* vals, uint32_t num,
+                         PyObject** kl, PyObject** vl) {
+  *kl = PyList_New(num);
+  *vl = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyList_SET_ITEM(*kl, i, PyLong_FromLong(keys[i]));
+    Py_INCREF(H(vals[i])->obj);
+    PyList_SET_ITEM(*vl, i, H(vals[i])->obj);
+  }
+}
+
+static int kv_call3(KVStoreHandle handle, const char* fn, uint32_t num,
+                    const int* keys, NDArrayHandle* vals, int priority,
+                    bool with_priority) {
+  MXTPU_API_BEGIN();
+  PyObject *kl, *vl;
+  kv_keys_vals(keys, vals, num, &kl, &vl);
+  PyObject* args = with_priority
+      ? Py_BuildValue("(ONNi)", H(handle)->obj, kl, vl, priority)
+      : Py_BuildValue("(ONN)", H(handle)->obj, kl, vl);
+  PyObject* r = capi_call(fn, args);
+  Py_XDECREF(r);
+  MXTPU_API_END();
+}
+
+int MXKVStoreInit(KVStoreHandle handle, uint32_t num, const int* keys,
+                  NDArrayHandle* vals) {
+  return kv_call3(handle, "kv_init", num, keys, vals, 0, false);
+}
+
+int MXKVStorePush(KVStoreHandle handle, uint32_t num, const int* keys,
+                  NDArrayHandle* vals, int priority) {
+  return kv_call3(handle, "kv_push", num, keys, vals, priority, true);
+}
+
+int MXKVStorePull(KVStoreHandle handle, uint32_t num, const int* keys,
+                  NDArrayHandle* vals, int priority) {
+  return kv_call3(handle, "kv_pull", num, keys, vals, priority, true);
+}
+
+static int kv_get_int(KVStoreHandle handle, const char* fn, int* out) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(fn, Py_BuildValue("(O)", H(handle)->obj));
+  if (!r) break;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  MXTPU_API_END();
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int* out) {
+  return kv_get_int(handle, "kv_rank", out);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int* out) {
+  return kv_get_int(handle, "kv_group_size", out);
+}
+
+int MXKVStoreGetType(KVStoreHandle handle, const char** out) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("kv_type", Py_BuildValue("(O)", H(handle)->obj));
+  if (!r) break;
+  const char* c = PyUnicode_AsUTF8(r);
+  if (!c) {
+    Py_DECREF(r);
+    break;
+  }
+  H(handle)->json = c;
+  Py_DECREF(r);
+  *out = H(handle)->json.c_str();
+  MXTPU_API_END();
+}
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("kv_barrier", Py_BuildValue("(O)", H(handle)->obj));
+  Py_XDECREF(r);
+  MXTPU_API_END();
+}
+
+/* ---------------- RecordIO ---------------- */
+
+static int recordio_open(const char* uri, const char* mode,
+                         RecordIOHandle* out) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("recordio_open", Py_BuildValue("(ss)", uri, mode));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out) {
+  return recordio_open(uri, "w", out);
+}
+
+int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out) {
+  return recordio_open(uri, "r", out);
+}
+
+static int recordio_free(RecordIOHandle handle) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(
+      "recordio_close", Py_BuildValue("(O)", H(handle)->obj));
+  Py_XDECREF(r);
+  MXTPU_API_END();
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  int rc = recordio_free(handle);
+  delete H(handle);
+  return rc;
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  int rc = recordio_free(handle);
+  delete H(handle);
+  return rc;
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char* buf,
+                                size_t size) {
+  MXTPU_API_BEGIN();
+  PyObject* raw = PyBytes_FromStringAndSize(buf, size);
+  PyObject* r = capi_call(
+      "recordio_write", Py_BuildValue("(ON)", H(handle)->obj, raw));
+  Py_XDECREF(r);
+  MXTPU_API_END();
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const** buf,
+                               size_t* size) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(
+      "recordio_read", Py_BuildValue("(O)", H(handle)->obj));
+  if (!r) break;
+  if (r == Py_None) {  // end of file — reference returns size 0
+    Py_DECREF(r);
+    *buf = nullptr;
+    *size = 0;
+  } else {
+    char* b;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(r, &b, &len) != 0) {
+      Py_DECREF(r);
+      break;
+    }
+    H(handle)->json.assign(b, len);
+    Py_DECREF(r);
+    *buf = H(handle)->json.data();
+    *size = (size_t)H(handle)->json.size();
+  }
+  MXTPU_API_END();
+}
+
+/* ---------------- DataIter ---------------- */
+
+namespace mxtpu {
+inline std::vector<std::string>& iter_table() {
+  static std::vector<std::string> names;
+  return names;
+}
+
+inline bool ensure_iter_table() {
+  return fill_name_table("list_data_iters", iter_table());
+}
+}  // namespace mxtpu
+
+int MXListDataIters(uint32_t* out_size, DataIterCreator** out_array) {
+  MXTPU_API_BEGIN();
+  if (!mxtpu::ensure_iter_table()) break;
+  static thread_local std::vector<DataIterCreator> creators;
+  creators.clear();
+  for (size_t i = 0; i < mxtpu::iter_table().size(); ++i)
+    creators.push_back((DataIterCreator)(uintptr_t)(i + 1));
+  *out_size = (uint32_t)creators.size();
+  *out_array = creators.data();
+  MXTPU_API_END();
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char** name,
+                          const char** description, uint32_t* num_args,
+                          const char*** arg_names, const char*** arg_types,
+                          const char*** arg_descs) {
+  MXTPU_API_BEGIN();
+  if (!mxtpu::ensure_iter_table()) break;
+  size_t idx = (size_t)(uintptr_t)creator;
+  if (idx == 0 || idx > mxtpu::iter_table().size()) {
+    g_last_error = "invalid DataIterCreator";
+    return -1;
+  }
+  *name = mxtpu::iter_table()[idx - 1].c_str();
+  if (description) *description = "";
+  // kwargs are python-documented; the C introspection surface reports none
+  if (num_args) *num_args = 0;
+  if (arg_names) *arg_names = nullptr;
+  if (arg_types) *arg_types = nullptr;
+  if (arg_descs) *arg_descs = nullptr;
+  MXTPU_API_END();
+}
+
+int MXDataIterCreateIter(DataIterCreator creator, uint32_t num_param,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out) {
+  MXTPU_API_BEGIN();
+  if (!mxtpu::ensure_iter_table()) break;
+  size_t idx = (size_t)(uintptr_t)creator;
+  if (idx == 0 || idx > mxtpu::iter_table().size()) {
+    g_last_error = "invalid DataIterCreator";
+    return -1;
+  }
+  PyObject* kl = PyList_New(num_param);
+  PyObject* vl = PyList_New(num_param);
+  for (uint32_t i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(kl, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(vl, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* r = capi_call(
+      "dataiter_create",
+      Py_BuildValue("(sNN)", mxtpu::iter_table()[idx - 1].c_str(), kl, vl));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  ensure_python();
+  delete H(handle);
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int* out) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(
+      "dataiter_next", Py_BuildValue("(O)", H(handle)->obj));
+  if (!r) break;
+  Handle* h = H(handle);
+  Py_XDECREF(h->obj2);
+  if (r == Py_None) {
+    Py_DECREF(r);
+    h->obj2 = nullptr;
+    *out = 0;
+  } else {
+    h->obj2 = r;  // current batch
+    *out = 1;
+  }
+  MXTPU_API_END();
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(
+      "dataiter_before_first", Py_BuildValue("(O)", H(handle)->obj));
+  Py_XDECREF(r);
+  MXTPU_API_END();
+}
+
+static int batch_part(DataIterHandle handle, const char* fn,
+                      NDArrayHandle* out) {
+  MXTPU_API_BEGIN();
+  if (!H(handle)->obj2) {
+    g_last_error = "no current batch; call MXDataIterNext first";
+    return -1;
+  }
+  PyObject* r = capi_call(fn, Py_BuildValue("(Oi)", H(handle)->obj2, 0));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out) {
+  return batch_part(handle, "batch_data", out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out) {
+  return batch_part(handle, "batch_label", out);
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int* pad) {
+  MXTPU_API_BEGIN();
+  if (!H(handle)->obj2) {
+    g_last_error = "no current batch; call MXDataIterNext first";
+    return -1;
+  }
+  PyObject* r = capi_call("batch_pad", Py_BuildValue("(O)", H(handle)->obj2));
+  if (!r) break;
+  *pad = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
   MXTPU_API_END();
 }
 

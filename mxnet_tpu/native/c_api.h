@@ -34,6 +34,10 @@ typedef void* NDArrayHandle;
 typedef void* SymbolHandle;
 typedef void* ExecutorHandle;
 typedef void* AtomicSymbolCreator;
+typedef void* KVStoreHandle;
+typedef void* RecordIOHandle;
+typedef void* DataIterHandle;
+typedef void* DataIterCreator;
 
 const char* MXGetLastError();
 
@@ -131,6 +135,49 @@ int MXNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle* out);
 int MXSymbolGetAttr(SymbolHandle symbol, const char* key, const char** out,
                     int* success);
 int MXSymbolSetAttr(SymbolHandle symbol, const char* key, const char* value);
+
+/* ---------------- KVStore (reference c_api.h MXKVStore*) ---------------- */
+int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle handle, uint32_t num, const int* keys,
+                  NDArrayHandle* vals);
+int MXKVStorePush(KVStoreHandle handle, uint32_t num, const int* keys,
+                  NDArrayHandle* vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, uint32_t num, const int* keys,
+                  NDArrayHandle* vals, int priority);
+int MXKVStoreGetRank(KVStoreHandle handle, int* out);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int* out);
+int MXKVStoreGetType(KVStoreHandle handle, const char** out);
+int MXKVStoreBarrier(KVStoreHandle handle);
+
+/* ---------------- RecordIO (reference MXRecordIO*) ---------------- */
+int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char* buf,
+                                size_t size);
+int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out);
+int MXRecordIOReaderFree(RecordIOHandle handle);
+/* returned buf is per-handle scratch, valid until the next read; size 0 at
+ * end of file */
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const** buf,
+                               size_t* size);
+
+/* ---------------- DataIter (reference MXDataIter*) ---------------- */
+int MXListDataIters(uint32_t* out_size, DataIterCreator** out_array);
+int MXDataIterGetIterInfo(DataIterCreator creator, const char** name,
+                          const char** description, uint32_t* num_args,
+                          const char*** arg_names, const char*** arg_types,
+                          const char*** arg_descs);
+int MXDataIterCreateIter(DataIterCreator creator, uint32_t num_param,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out);
+int MXDataIterFree(DataIterHandle handle);
+int MXDataIterNext(DataIterHandle handle, int* out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+/* returned handles are NEW references the caller must MXNDArrayFree */
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out);
+int MXDataIterGetPadNum(DataIterHandle handle, int* pad);
 
 #ifdef __cplusplus
 }

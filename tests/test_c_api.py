@@ -321,6 +321,28 @@ def test_c_api_imperative_invoke_and_views(amalgamated, tmp_path):
     a = ctypes.c_void_p()
     assert lib.MXNDArrayAt(s, 0, ctypes.byref(a)) == 0
 
+    # write-through views (reference aliasing contract): fill a batch
+    # row by row through sliced handles, then read the PARENT
+    batch_h = ctypes.c_void_p()
+    bshape = (ctypes.c_uint32 * 2)(3, 4)
+    assert lib.MXNDArrayCreateEx(bshape, 2, 1, 0, 0, 0,
+                                 ctypes.byref(batch_h)) == 0
+    for i in range(3):
+        row = ctypes.c_void_p()
+        assert lib.MXNDArraySlice(batch_h, i, i + 1, ctypes.byref(row)) == 0
+        rowdata = np.full((1, 4), float(i + 1), np.float32)
+        assert lib.MXNDArraySyncCopyFromCPU(
+            row, rowdata.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_size_t(4)) == 0
+        lib.MXNDArrayFree(row)
+    whole = np.zeros((3, 4), np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(
+        batch_h, whole.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_size_t(12)) == 0
+    np.testing.assert_array_equal(
+        whole, np.repeat([[1.0], [2.0], [3.0]], 4, axis=1))
+    lib.MXNDArrayFree(batch_h)
+
     # symbol attrs
     sym = ctypes.c_void_p()
     js = mx.sym.Variable("w").tojson().encode()
@@ -334,3 +356,112 @@ def test_c_api_imperative_invoke_and_views(amalgamated, tmp_path):
     for handle in (h, y, r, s, a):
         lib.MXNDArrayFree(handle)
     lib.MXSymbolFree(sym)
+
+
+def test_c_api_kvstore_recordio_dataiter(amalgamated, tmp_path):
+    """Tier-3 C surface: KVStore init/push/pull through handles, RecordIO
+    write/read roundtrip, and a CSVIter driven batch-by-batch — the
+    remaining MX* families every binding consumes (reference c_api.h
+    MXKVStore*/MXRecordIO*/MXDataIter*)."""
+    import ctypes
+
+    lib = ctypes.CDLL(os.path.join(amalgamated, "libmxtpu.so"))
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    # --- KVStore: init key 3 to ones, push 2x, pull back 3x (local sums)
+    kv = ctypes.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    t = ctypes.c_char_p()
+    assert lib.MXKVStoreGetType(kv, ctypes.byref(t)) == 0
+    assert t.value == b"local"
+    r = ctypes.c_int()
+    assert lib.MXKVStoreGetRank(kv, ctypes.byref(r)) == 0 and r.value == 0
+
+    def make_nd(vals):
+        h = ctypes.c_void_p()
+        shape = (ctypes.c_uint32 * 1)(len(vals))
+        assert lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                     ctypes.byref(h)) == 0
+        arr = np.asarray(vals, np.float32)
+        assert lib.MXNDArraySyncCopyFromCPU(
+            h, arr.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_size_t(len(vals))) == 0
+        return h
+
+    keys = (ctypes.c_int * 1)(3)
+    init_v = (ctypes.c_void_p * 1)(make_nd([1.0, 1.0]))
+    assert lib.MXKVStoreInit(kv, 1, keys, init_v) == 0, lib.MXGetLastError()
+    push_v = (ctypes.c_void_p * 1)(make_nd([2.0, 5.0]))
+    assert lib.MXKVStorePush(kv, 1, keys, push_v, 0) == 0
+    out_h = make_nd([0.0, 0.0])
+    pull_v = (ctypes.c_void_p * 1)(out_h)
+    assert lib.MXKVStorePull(kv, 1, keys, pull_v, 0) == 0
+    got = np.zeros(2, np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(
+        out_h, got.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(2)) == 0
+    np.testing.assert_array_equal(got, [2.0, 5.0])
+    assert lib.MXKVStoreFree(kv) == 0
+
+    # --- RecordIO roundtrip through the C surface
+    rec_path = str(tmp_path / "c.rec").encode()
+    w = ctypes.c_void_p()
+    assert lib.MXRecordIOWriterCreate(rec_path, ctypes.byref(w)) == 0
+    payloads = [b"hello", b"tpu" * 40, b""]
+    for p in payloads:
+        assert lib.MXRecordIOWriterWriteRecord(
+            w, p, ctypes.c_size_t(len(p))) == 0
+    assert lib.MXRecordIOWriterFree(w) == 0
+    rd = ctypes.c_void_p()
+    assert lib.MXRecordIOReaderCreate(rec_path, ctypes.byref(rd)) == 0
+    buf = ctypes.c_char_p()
+    size = ctypes.c_size_t()
+    out_payloads = []
+    while True:
+        assert lib.MXRecordIOReaderReadRecord(
+            rd, ctypes.byref(buf), ctypes.byref(size)) == 0
+        if size.value == 0 and buf.value is None:
+            break
+        out_payloads.append(ctypes.string_at(buf, size.value))
+    assert lib.MXRecordIOReaderFree(rd) == 0
+    assert out_payloads[:2] == payloads[:2]
+
+    # --- DataIter: CSVIter over a small file, batch by batch
+    n_it = ctypes.c_uint32()
+    its = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXListDataIters(ctypes.byref(n_it), ctypes.byref(its)) == 0
+    name = ctypes.c_char_p()
+    csv_creator = None
+    for i in range(n_it.value):
+        c = ctypes.c_void_p(its[i])
+        assert lib.MXDataIterGetIterInfo(
+            c, ctypes.byref(name), None, None, None, None, None) == 0
+        if name.value == b"CSVIter":
+            csv_creator = ctypes.c_void_p(its[i])
+    assert csv_creator is not None
+    csv = tmp_path / "d.csv"
+    data = np.arange(24, dtype=np.float32).reshape(8, 3)
+    np.savetxt(csv, data, delimiter=",", fmt="%.1f")
+    ikeys = (ctypes.c_char_p * 3)(b"data_csv", b"data_shape", b"batch_size")
+    ivals = (ctypes.c_char_p * 3)(str(csv).encode(), b"(3,)", b"4")
+    it = ctypes.c_void_p()
+    assert lib.MXDataIterCreateIter(csv_creator, 3, ikeys, ivals,
+                                    ctypes.byref(it)) == 0, \
+        lib.MXGetLastError()
+    rows = []
+    has = ctypes.c_int()
+    while True:
+        assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0
+        if not has.value:
+            break
+        dh = ctypes.c_void_p()
+        assert lib.MXDataIterGetData(it, ctypes.byref(dh)) == 0
+        batch = np.zeros((4, 3), np.float32)
+        assert lib.MXNDArraySyncCopyToCPU(
+            dh, batch.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_size_t(12)) == 0
+        rows.append(batch.copy())
+        lib.MXNDArrayFree(dh)
+    assert lib.MXDataIterBeforeFirst(it) == 0
+    assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0 and has.value == 1
+    assert lib.MXDataIterFree(it) == 0
+    np.testing.assert_array_equal(np.concatenate(rows), data)
