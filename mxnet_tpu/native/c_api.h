@@ -157,8 +157,9 @@ int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char* buf,
                                 size_t size);
 int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out);
 int MXRecordIOReaderFree(RecordIOHandle handle);
-/* returned buf is per-handle scratch, valid until the next read; size 0 at
- * end of file */
+/* returned buf is per-handle scratch, valid until the next read. End of
+ * file is signaled by *buf == NULL (with *size == 0); a legitimate
+ * zero-length record returns a non-NULL buf with *size == 0. */
 int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const** buf,
                                size_t* size);
 

@@ -1,0 +1,121 @@
+"""BatchNorm folding: the inference graph rewrite must preserve outputs
+while removing the foldable BN nodes (contrib/quantize_fold.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _forward(sym, params, aux, x):
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=x.shape,
+                          softmax_label=(x.shape[0],))
+    for n, v in params.items():
+        if n in exe.arg_dict:
+            exe.arg_dict[n][:] = v
+    for n, v in aux.items():
+        if n in exe.aux_dict:
+            exe.aux_dict[n][:] = v
+    exe.arg_dict["data"][:] = x
+    return exe.forward(is_train=False)[0].asnumpy()
+
+
+def test_fold_batchnorm_preserves_resnet_outputs():
+    sym = models.resnet(num_classes=8, num_layers=18, image_shape="3,32,32")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 3, 32, 32))],
+             label_shapes=[("softmax_label", (2,))])
+    mx.random.seed(0)
+    mod.init_params(initializer=mx.init.Xavier())
+    # give the moving stats non-trivial values so the fold actually matters
+    rng = np.random.RandomState(1)
+    arg_params, aux_params = mod.get_params()
+    for n, v in aux_params.items():
+        if n.endswith("moving_mean"):
+            v[:] = rng.uniform(-0.5, 0.5, v.shape).astype(np.float32)
+        else:
+            v[:] = rng.uniform(0.5, 2.0, v.shape).astype(np.float32)
+
+    x = rng.uniform(0, 1, (2, 3, 32, 32)).astype(np.float32)
+    before = _forward(sym, arg_params, aux_params, x)
+
+    folded_sym, folded_args = mx.contrib.fold_batchnorm(
+        sym, arg_params, aux_params)
+    # every BN with a conv producer is gone; resnet-18's BNs either follow
+    # convs directly or sit pre-activation (data BN) — count must shrink
+    def bn_count(s):
+        return sum(1 for n in s._topo()
+                   if not n.is_variable and n.op.name == "BatchNorm")
+    assert bn_count(folded_sym) < bn_count(sym)
+    after = _forward(folded_sym, folded_args, aux_params, x)
+    assert_almost_equal(before, after, rtol=1e-4, atol=1e-5)
+
+
+def test_fold_batchnorm_fc_and_shared_producer_guard():
+    # FC + BN folds; a conv consumed by BN AND a residual add must NOT fold
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    bn = mx.sym.BatchNorm(fc, fix_gamma=False, name="bn1")
+    shared = mx.sym.FullyConnected(bn, num_hidden=8, name="fc2",
+                                   no_bias=True)
+    bn2 = mx.sym.BatchNorm(shared, fix_gamma=True, name="bn2")
+    both = bn2 + shared  # fc2 has two consumers -> bn2 must stay
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(both, num_hidden=4,
+                                                     name="fc3"),
+                               name="softmax")
+    exe_shapes = {"data": (4, 6), "softmax_label": (4,)}
+    exe = net.simple_bind(mx.cpu(), grad_req="null", **exe_shapes)
+    rng = np.random.RandomState(0)
+    arg_params, aux_params = {}, {}
+    for n, a in exe.arg_dict.items():
+        if n not in exe_shapes:
+            arg_params[n] = mx.nd.array(
+                rng.uniform(-0.2, 0.2, a.shape).astype(np.float32))
+    for n, a in exe.aux_dict.items():
+        base = 1.0 if "var" in n else 0.1
+        aux_params[n] = mx.nd.array(
+            rng.uniform(base, base + 0.5, a.shape).astype(np.float32))
+
+    x = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+    before = _forward(net, arg_params, aux_params, x)
+    folded, fargs = mx.contrib.fold_batchnorm(net, arg_params, aux_params)
+    names = [n.op.name for n in folded._topo() if not n.is_variable]
+    assert names.count("BatchNorm") == 1  # bn2 kept, bn1 folded
+    after = _forward(folded, fargs, aux_params, x)
+    assert_almost_equal(before, after, rtol=1e-4, atol=1e-5)
+
+
+def test_fold_batchnorm_skips_shared_weights():
+    """A weight tied between two layers must never be rewritten: folding
+    bn over conv1 would corrupt conv2's math."""
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w_shared")
+    c1 = mx.sym.FullyConnected(data, weight=w, num_hidden=6, name="c1",
+                               no_bias=True)
+    bn = mx.sym.BatchNorm(c1, fix_gamma=False, name="bn")
+    c2 = mx.sym.FullyConnected(data, weight=w, num_hidden=6, name="c2",
+                               no_bias=True)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(bn + c2, num_hidden=3, name="head"),
+        name="softmax")
+    shapes = {"data": (4, 5), "softmax_label": (4,)}
+    exe = net.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    rng = np.random.RandomState(2)
+    arg_params, aux_params = {}, {}
+    for n, a in exe.arg_dict.items():
+        if n not in shapes:
+            arg_params[n] = mx.nd.array(
+                rng.uniform(-0.3, 0.3, a.shape).astype(np.float32))
+    for n, a in exe.aux_dict.items():
+        base = 1.0 if "var" in n else 0.1
+        aux_params[n] = mx.nd.array(
+            rng.uniform(base, base + 0.5, a.shape).astype(np.float32))
+    x = rng.uniform(-1, 1, (4, 5)).astype(np.float32)
+    before = _forward(net, arg_params, aux_params, x)
+    folded, fargs = mx.contrib.fold_batchnorm(net, arg_params, aux_params)
+    # bn must survive (shared weight) and outputs stay identical
+    names = [n.op.name for n in folded._topo() if not n.is_variable]
+    assert names.count("BatchNorm") == 1
+    after = _forward(folded, fargs, aux_params, x)
+    assert_almost_equal(before, after, rtol=1e-5, atol=1e-6)
