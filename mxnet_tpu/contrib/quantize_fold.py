@@ -102,6 +102,11 @@ def fold_batchnorm(symbol, arg_params, aux_params):
         prod_params = prod.params()
         w_name = prod.inputs[1][0].name
         W = param_val(w_name)
+        if W.shape[0] != scale.shape[0]:
+            # the BN channel axis is not the producer's output-channel
+            # axis (e.g. FullyConnected with flatten=False on >2D data,
+            # where BN axis 1 normalizes the sequence dim) — not foldable
+            return None
         bshape = (-1,) + (1,) * (W.ndim - 1)
         new_w = W * scale.reshape(bshape)
         if prod_params["no_bias"]:

@@ -19,7 +19,10 @@ set_optimizer/rank/num_workers/barrier) as the coordination surface:
 * ``dist_sync``/``dist_device_sync`` → same semantics on a multi-host jax
   runtime: every host runs the same program, collectives ride ICI/DCN inside
   the jitted step, and rank/num_workers map to jax process index/count.
-  ``dist_async`` has no idiomatic analogue (documented; created as sync).
+  ``dist_async`` is a genuine hogwild parameter server hosted on rank 0's
+  process (kvstore_async.py) — there is no on-chip analogue of
+  unsynchronized updates, so it is faithfully a host-side subsystem like
+  the reference's ps-lite servers.
 """
 
 from __future__ import annotations
@@ -233,14 +236,8 @@ class DistKVStore(KVStore):
                 f"processes but MXNET_NUM_PROCS={nproc}; import mxnet_tpu "
                 "before any other jax use in launched workers"
             )
-        if "async" in kv_type:
-            import logging
-
-            logging.warning(
-                "dist_async has no idiomatic TPU analogue (hogwild updates "
-                "do not exist in an SPMD program); running bulk-synchronous "
-                "like dist_sync. See SURVEY.md §2.5."
-            )
+        # dist_async never reaches this class: create() routes it to the
+        # host-side parameter server (kvstore_async.py)
 
     @property
     def rank(self):
@@ -354,6 +351,10 @@ def create(name="local"):
     """Create a KVStore (reference ``mx.kv.create``, kvstore.cc:16-44)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
+    if "dist" in name and "async" in name:
+        from .kvstore_async import AsyncDistKVStore
+
+        return AsyncDistKVStore(name)
     if "dist" in name:
         return DistKVStore(name)
     return KVStore(name)

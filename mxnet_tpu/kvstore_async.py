@@ -1,0 +1,337 @@
+"""``dist_async`` — a host-side asynchronous parameter server.
+
+Reference: ``dist_async`` mode applies every worker's push to the server's
+weights IMMEDIATELY (hogwild), with no synchronization between workers —
+``src/kvstore/kvstore_dist_server.h:319+`` (async branch of
+DataHandleDefault), server processes launched by the tracker and the
+optimizer shipped from worker 0 (``python/mxnet/kvstore_server.py``).
+
+There is no idiomatic on-chip analogue (an SPMD program cannot hogwild),
+so this is faithfully a HOST-side subsystem: rank 0's process hosts the
+server thread (the tracker-launched-server analogue for the TPU world,
+where every host already runs a worker), and workers talk to it over TCP
+with length-prefixed pickles. Pushes take the server lock, apply the
+updater (or sum-accumulate when none is installed) and return; pulls read
+the current weights. No barriers anywhere in the data path — stale
+gradients are the documented semantics, exactly like the reference.
+
+Rendezvous: the server binds on the MXNET_COORDINATOR host (exported by
+tools/launch.py) at the coordinator port + 512; MXNET_PS_PORT overrides
+the port if that one is taken (set it yourself — launch.py does not).
+
+Lifecycle: every client sends a ``done`` marker at interpreter exit, and
+rank 0's exit hook keeps the server alive until all workers have reported
+done (or a generous timeout), so naturally-finishing async jobs need no
+explicit barriers even though rank 0 usually finishes its shard first.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from .base import MXNetError
+from .kvstore import KVStore, _key_str, _updater_key
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class _PSServer:
+    """The parameter-server state machine hosted by rank 0."""
+
+    def __init__(self, host, port, num_workers):
+        self._store = {}
+        self._updater = None
+        self._lock = threading.Lock()
+        self._updater_cv = threading.Condition(self._lock)
+        self._num_workers = num_workers
+        self._done_count = 0
+        self._done_cv = threading.Condition(self._lock)
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition(self._lock)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(num_workers * 2)
+        self._stop = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def set_updater(self, updater):
+        with self._updater_cv:
+            self._updater = updater
+            self._updater_cv.notify_all()
+
+    def wait_all_done(self, timeout=120.0):
+        deadline = time.time() + timeout
+        with self._done_cv:
+            while self._done_count < self._num_workers:
+                left = deadline - time.time()
+                if left <= 0:
+                    return False
+                self._done_cv.wait(left)
+        return True
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                op = msg[0]
+                if op == "init":
+                    _, key, arr = msg
+                    with self._lock:
+                        # first init wins (reference CHECK on re-init is
+                        # relaxed: every worker inits the same values)
+                        self._store.setdefault(key, arr.copy())
+                    _send_msg(conn, ("ok",))
+                elif op == "push":
+                    _, key, grad, expect_updater = msg
+                    with self._updater_cv:
+                        if key not in self._store:
+                            _send_msg(conn, ("err", f"init {key} first"))
+                            continue
+                        # a TRAINING push (client has an optimizer) may race
+                        # ahead of rank 0 installing the server updater;
+                        # wait for it instead of mis-applying raw gradients
+                        if expect_updater and self._updater is None:
+                            deadline = time.time() + 60
+                            while self._updater is None:
+                                left = deadline - time.time()
+                                if left <= 0:
+                                    break
+                                self._updater_cv.wait(left)
+                        if expect_updater and self._updater is None:
+                            _send_msg(conn, (
+                                "err",
+                                "no server optimizer installed (rank 0 "
+                                "never called set_optimizer)"))
+                            continue
+                        if self._updater is not None:
+                            # hogwild: apply THIS worker's gradient now
+                            from .ndarray import array
+
+                            w = array(self._store[key])
+                            self._updater(_updater_key(key), array(grad), w)
+                            self._store[key] = w.asnumpy()
+                        else:
+                            # no optimizer anywhere: plain store semantics —
+                            # push REPLACES, like every other KVStore here
+                            self._store[key] = grad.copy()
+                    _send_msg(conn, ("ok",))
+                elif op == "pull":
+                    _, key = msg
+                    with self._lock:
+                        arr = self._store.get(key)
+                    if arr is None:
+                        _send_msg(conn, ("err", f"init {key} first"))
+                    else:
+                        _send_msg(conn, ("val", arr))
+                elif op == "barrier":
+                    with self._barrier_cv:
+                        gen = self._barrier_gen
+                        self._barrier_count += 1
+                        if self._barrier_count == self._num_workers:
+                            self._barrier_count = 0
+                            self._barrier_gen += 1
+                            self._barrier_cv.notify_all()
+                        else:
+                            while gen == self._barrier_gen:
+                                self._barrier_cv.wait()
+                    _send_msg(conn, ("ok",))
+                elif op == "done":
+                    with self._done_cv:
+                        self._done_count += 1
+                        self._done_cv.notify_all()
+                    _send_msg(conn, ("ok",))
+                elif op == "stop":
+                    _send_msg(conn, ("ok",))
+                    return
+                else:
+                    _send_msg(conn, ("err", f"unknown op {op!r}"))
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def shutdown(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class AsyncDistKVStore(KVStore):
+    """dist_async client (+ embedded server on rank 0)."""
+
+    def __init__(self, kv_type="dist_async"):
+        super().__init__(kv_type)
+        self._rank = int(os.environ.get("MXNET_PROC_ID", "0"))
+        self._size = int(os.environ.get("MXNET_NUM_PROCS", "1"))
+        coord = os.environ.get("MXNET_COORDINATOR", "127.0.0.1:9127")
+        host, _, port = coord.rpartition(":")
+        ps_port = int(os.environ.get("MXNET_PS_PORT", int(port) + 512))
+        self._server = None
+        if self._rank == 0:
+            self._server = _PSServer(host or "127.0.0.1", ps_port, self._size)
+        self._addr = (host or "127.0.0.1", ps_port)
+        self._sock = None
+        self._sock_lock = threading.Lock()
+        self._has_optimizer = False
+        self._done_sent = False
+        import atexit
+
+        atexit.register(self._at_exit)
+
+    # --- transport ------------------------------------------------------
+    def _conn(self):
+        if self._sock is None:
+            deadline = time.time() + 60
+            last = None
+            while time.time() < deadline:
+                try:
+                    s = socket.create_connection(self._addr, timeout=30)
+                    # RPCs may legitimately block far longer than the
+                    # connect timeout (barrier with a straggler, a push
+                    # waiting for the server optimizer)
+                    s.settimeout(None)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    self._sock = s
+                    break
+                except OSError as e:  # server not up yet
+                    last = e
+                    time.sleep(0.1)
+            if self._sock is None:
+                raise MXNetError(f"dist_async: cannot reach server: {last}")
+        return self._sock
+
+    def _rpc(self, *msg):
+        with self._sock_lock:
+            sock = self._conn()
+            _send_msg(sock, msg)
+            resp = _recv_msg(sock)
+        if resp[0] == "err":
+            raise MXNetError(f"dist_async server: {resp[1]}")
+        return resp[1] if len(resp) > 1 else None
+
+    # --- KVStore interface ----------------------------------------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._size
+
+    def init(self, key, value):
+        from .ndarray import NDArray
+
+        keys, vals = _as_lists(key, value)
+        for k, v in zip(keys, vals):
+            arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+            self._rpc("init", _key_str(k), arr)
+
+    def push(self, key, value, priority=0):
+        from .kvstore import _merge_pushed
+
+        keys, vals = _as_lists(key, value)
+        for k, v in zip(keys, vals):
+            merged = _merge_pushed(v)
+            self._rpc("push", _key_str(k), np.asarray(merged.asnumpy()),
+                      self._has_optimizer)
+
+    def pull(self, key, out=None, priority=0):
+        from .ndarray import NDArray
+
+        keys, outs = _as_lists(key, out)
+        for k, o in zip(keys, outs):
+            arr = self._rpc("pull", _key_str(k))
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                if isinstance(t, NDArray):
+                    t[:] = arr
+        return out
+
+    def set_optimizer(self, optimizer):
+        """Only rank 0's optimizer reaches the server (reference: worker 0
+        ships the pickled optimizer to servers, kvstore.py:238-276)."""
+        from . import optimizer as opt
+
+        self._updater = opt.get_updater(optimizer)  # local mirror (API)
+        self._has_optimizer = True
+        if self._server is not None:
+            self._server.set_updater(opt.get_updater(optimizer))
+
+    def barrier(self):
+        self._rpc("barrier")
+
+    @property
+    def type(self):
+        return self._type
+
+    def _at_exit(self):
+        """Lifecycle contract: report done; rank 0 then keeps the server
+        alive until every worker has reported, so async jobs finish
+        cleanly with no barriers even when rank 0 ends first."""
+        if not self._done_sent:
+            self._done_sent = True
+            try:
+                self._rpc("done")
+            except (MXNetError, OSError):
+                pass
+        if self._server is not None:
+            self._server.wait_all_done()
+            self._server.shutdown()
+            self._server = None
+
+    def close(self):
+        self._at_exit()
+        try:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+        except OSError:
+            pass
+
+
+def _as_lists(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]

@@ -1,0 +1,68 @@
+"""Worker for the dist_async (hogwild parameter server) test.
+
+Reference semantics (kvstore_dist_server.h async branch): each push applies
+immediately server-side — no worker synchronization in the data path.
+Every rank trains on its shard with update_on_kvstore semantics (push
+grads, pull fresh weights); ranks progress at their own pace, and the
+server's weights must still converge.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_async")
+    rank, nw = kv.rank, kv.num_workers
+    assert kv.type == "dist_async"
+
+    rng = np.random.RandomState(42)
+    X = rng.randn(256, 10).astype(np.float32)
+    W = rng.randn(10, 4).astype(np.float32)
+    Y = X.dot(W).argmax(1).astype(np.float32)
+    Xs, Ys = X[rank::nw], Y[rank::nw]
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=16, name="fc1"),
+        act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=4, name="fc2"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(Xs, Ys, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mx.random.seed(7)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(
+        kvstore=kv, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "rescale_grad": 1.0 / 16},
+    )
+    # async contract: the module must be updating ON the kvstore (server
+    # applies pushes immediately; no cross-worker barrier in the loop)
+    assert mod._update_on_kvstore
+
+    metric = mx.metric.Accuracy()
+    for epoch in range(30):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+    acc = metric.get()[1]
+    assert acc > 0.8, f"rank {rank}: async training stuck at {acc}"
+    print(f"rank {rank}/{nw} ASYNC-TRAIN OK acc={acc:.3f}", flush=True)
+    # NO barriers: ranks exit whenever they finish; the kvstore's exit
+    # hook keeps rank 0's server alive until all workers reported done
+
+
+if __name__ == "__main__":
+    main()

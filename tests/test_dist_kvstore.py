@@ -137,3 +137,25 @@ def test_mid_training_worker_kill_recovers_and_converges():
     for r in range(2):
         assert f"rank {r}/2 FAULT-RECOVERY OK" in out, out[-4000:]
     assert "dead=1" in out, out[-4000:]
+
+
+def test_dist_async_parameter_server_trains():
+    """dist_async is a REAL hogwild parameter server (kvstore_async.py):
+    rank 0 hosts it, pushes apply immediately with no worker barriers
+    (reference kvstore_dist_server.h async branch), and training still
+    converges on every rank."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+        "-n", "2", "--launcher", "local", "--port", str(_free_port()),
+        sys.executable, os.path.join(_ROOT, "tests", "dist_async_worker.py"),
+    ]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"async training failed:\n{out[-4000:]}"
+    for r in range(2):
+        assert f"rank {r}/2 ASYNC-TRAIN OK" in out, out[-4000:]

@@ -119,3 +119,33 @@ def test_fold_batchnorm_skips_shared_weights():
     assert names.count("BatchNorm") == 1
     after = _forward(folded, fargs, aux_params, x)
     assert_almost_equal(before, after, rtol=1e-5, atol=1e-6)
+
+
+def test_fold_batchnorm_skips_mismatched_channel_axis():
+    """FC(flatten=False) on 3-D data: BN axis 1 normalizes the sequence
+    dim, not the FC output channels — must be left unfolded, not crash."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=6, name="fc",
+                               flatten=False)
+    bn = mx.sym.BatchNorm(fc, fix_gamma=False, name="bn")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(bn, num_hidden=3, name="head"), name="softmax")
+    shapes = {"data": (2, 5, 4), "softmax_label": (2,)}
+    exe = net.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    rng = np.random.RandomState(3)
+    arg_params, aux_params = {}, {}
+    for n, a in exe.arg_dict.items():
+        if n not in shapes:
+            arg_params[n] = mx.nd.array(
+                rng.uniform(-0.3, 0.3, a.shape).astype(np.float32))
+    for n, a in exe.aux_dict.items():
+        base = 1.0 if "var" in n else 0.1
+        aux_params[n] = mx.nd.array(
+            rng.uniform(base, base + 0.5, a.shape).astype(np.float32))
+    x = rng.uniform(-1, 1, (2, 5, 4)).astype(np.float32)
+    before = _forward(net, arg_params, aux_params, x)
+    folded, fargs = mx.contrib.fold_batchnorm(net, arg_params, aux_params)
+    names = [n.op.name for n in folded._topo() if not n.is_variable]
+    assert names.count("BatchNorm") == 1  # kept
+    after = _forward(folded, fargs, aux_params, x)
+    assert_almost_equal(before, after, rtol=1e-5, atol=1e-6)
