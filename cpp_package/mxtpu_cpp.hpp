@@ -1,0 +1,268 @@
+// Header-only C++ binding over the core C ABI.
+//
+// Reference: cpp-package/include/mxnet-cpp (header-only wrappers generated
+// over the C ABI, with RAII handles and operator sugar; examples mlp.cpp /
+// lenet.cpp, CI via cpp-package/tests/ci_test.sh). This is the TPU-native
+// analogue over mxtpu.h / libmxtpu.so: NDArray, Symbol and Executor RAII
+// classes plus imperative op invocation — enough surface for the
+// reference-style C++ inference/training clients.
+//
+// Build: compile against the amalgamated header+library
+// (tools/amalgamation.py):
+//   g++ -std=c++17 my_app.cc -I<amal_dir> -I<repo>/cpp_package \
+//       <amal_dir>/libmxtpu.so -Wl,-rpath,<amal_dir>
+#ifndef MXTPU_CPP_HPP_
+#define MXTPU_CPP_HPP_
+
+#include <cstdint>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxtpu.h"
+
+namespace mxtpu {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXGetLastError());
+}
+
+class NDArray {
+ public:
+  NDArray() : handle_(nullptr), owned_(false) {}
+  explicit NDArray(NDArrayHandle h, bool owned = true)
+      : handle_(h), owned_(owned) {}
+  NDArray(const std::vector<uint32_t>& shape, int dev_type = 1,
+          int dev_id = 0, int dtype = 0)
+      : owned_(true) {
+    Check(MXNDArrayCreateEx(shape.data(), (uint32_t)shape.size(), dev_type,
+                            dev_id, 0, dtype, &handle_));
+  }
+  NDArray(NDArray&& o) noexcept : handle_(o.handle_), owned_(o.owned_) {
+    o.handle_ = nullptr;
+    o.owned_ = false;
+  }
+  NDArray& operator=(NDArray&& o) noexcept {
+    reset();
+    handle_ = o.handle_;
+    owned_ = o.owned_;
+    o.handle_ = nullptr;
+    o.owned_ = false;
+    return *this;
+  }
+  NDArray(const NDArray&) = delete;
+  NDArray& operator=(const NDArray&) = delete;
+  ~NDArray() { reset(); }
+
+  NDArrayHandle handle() const { return handle_; }
+  bool valid() const { return handle_ != nullptr; }
+
+  void SyncCopyFromCPU(const float* data, size_t n_elem) {
+    Check(MXNDArraySyncCopyFromCPU(handle_, data, n_elem));
+  }
+  void SyncCopyToCPU(float* data, size_t n_elem) const {
+    Check(MXNDArraySyncCopyToCPU(handle_, data, n_elem));
+  }
+  std::vector<uint32_t> shape() const {
+    uint32_t ndim;
+    const uint32_t* dims;
+    Check(MXNDArrayGetShape(handle_, &ndim, &dims));
+    return std::vector<uint32_t>(dims, dims + ndim);
+  }
+  size_t size() const {
+    size_t s = 1;
+    for (uint32_t d : shape()) s *= d;
+    return s;
+  }
+  int dtype() const {
+    int dt;
+    Check(MXNDArrayGetDType(handle_, &dt));
+    return dt;
+  }
+  NDArray Slice(uint32_t begin, uint32_t end) const {
+    NDArrayHandle out;
+    Check(MXNDArraySlice(handle_, begin, end, &out));
+    return NDArray(out);
+  }
+  NDArray Reshape(const std::vector<int>& dims) const {
+    NDArrayHandle out;
+    Check(MXNDArrayReshape(handle_, (int)dims.size(),
+                           const_cast<int*>(dims.data()), &out));
+    return NDArray(out);
+  }
+  static void Save(const std::string& fname,
+                   const std::map<std::string, NDArray*>& arrays) {
+    std::vector<NDArrayHandle> handles;
+    std::vector<const char*> keys;
+    for (auto& kv : arrays) {
+      keys.push_back(kv.first.c_str());
+      handles.push_back(kv.second->handle());
+    }
+    Check(MXNDArraySave(fname.c_str(), (uint32_t)handles.size(),
+                        handles.data(), keys.data()));
+  }
+  static std::map<std::string, NDArray> Load(const std::string& fname) {
+    uint32_t n, n_names;
+    NDArrayHandle* arrs;
+    const char** names;
+    Check(MXNDArrayLoad(fname.c_str(), &n, &arrs, &n_names, &names));
+    std::map<std::string, NDArray> out;
+    for (uint32_t i = 0; i < n; ++i) {
+      std::string key = (i < n_names) ? names[i] : std::to_string(i);
+      out.emplace(key, NDArray(arrs[i]));
+    }
+    return out;
+  }
+
+ private:
+  void reset() {
+    if (handle_ && owned_) MXNDArrayFree(handle_);
+    handle_ = nullptr;
+  }
+  NDArrayHandle handle_;
+  bool owned_;
+};
+
+// imperative op invocation (the generated-operator analogue of
+// cpp-package's op.h, resolved by name at runtime)
+inline std::vector<NDArray> Invoke(
+    const std::string& op_name, const std::vector<NDArray*>& inputs,
+    const std::map<std::string, std::string>& params = {}) {
+  static std::map<std::string, AtomicSymbolCreator> registry = [] {
+    std::map<std::string, AtomicSymbolCreator> reg;
+    uint32_t n;
+    AtomicSymbolCreator* creators;
+    Check(MXSymbolListAtomicSymbolCreators(&n, &creators));
+    for (uint32_t i = 0; i < n; ++i) {
+      const char* name;
+      Check(MXSymbolGetAtomicSymbolName(creators[i], &name));
+      reg[name] = creators[i];
+    }
+    return reg;
+  }();
+  auto it = registry.find(op_name);
+  if (it == registry.end())
+    throw std::runtime_error("unknown op " + op_name);
+  std::vector<NDArrayHandle> ins;
+  for (auto* p : inputs) ins.push_back(p->handle());
+  std::vector<const char*> keys, vals;
+  for (auto& kv : params) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  int n_out = 0;
+  NDArrayHandle* outs = nullptr;
+  Check(MXImperativeInvoke(it->second, (int)ins.size(), ins.data(), &n_out,
+                           &outs, (int)keys.size(), keys.data(),
+                           vals.data()));
+  std::vector<NDArray> result;
+  for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+  return result;
+}
+
+class Symbol {
+ public:
+  Symbol() : handle_(nullptr) {}
+  explicit Symbol(SymbolHandle h) : handle_(h) {}
+  static Symbol FromJSON(const std::string& json) {
+    SymbolHandle h;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+  static Symbol FromFile(const std::string& fname) {
+    SymbolHandle h;
+    Check(MXSymbolCreateFromFile(fname.c_str(), &h));
+    return Symbol(h);
+  }
+  Symbol(Symbol&& o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
+  Symbol& operator=(Symbol&& o) noexcept {
+    if (handle_) MXSymbolFree(handle_);
+    handle_ = o.handle_;
+    o.handle_ = nullptr;
+    return *this;
+  }
+  Symbol(const Symbol&) = delete;
+  Symbol& operator=(const Symbol&) = delete;
+  ~Symbol() {
+    if (handle_) MXSymbolFree(handle_);
+  }
+
+  SymbolHandle handle() const { return handle_; }
+  std::string ToJSON() const {
+    const char* js;
+    Check(MXSymbolSaveToJSON(handle_, &js));
+    return js;
+  }
+  std::vector<std::string> ListArguments() const {
+    return list_impl(MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return list_impl(MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return list_impl(MXSymbolListAuxiliaryStates);
+  }
+
+ private:
+  template <typename F>
+  std::vector<std::string> list_impl(F f) const {
+    uint32_t n;
+    const char** strs;
+    Check(f(handle_, &n, &strs));
+    return std::vector<std::string>(strs, strs + n);
+  }
+  SymbolHandle handle_;
+};
+
+class Executor {
+ public:
+  // in_args parallel to symbol.ListArguments(); aux parallel to
+  // ListAuxiliaryStates(); grad_req 0 everywhere = inference
+  Executor(const Symbol& symbol, int dev_type, int dev_id,
+           const std::vector<NDArray*>& in_args,
+           const std::vector<NDArray*>& aux_states = {},
+           const std::vector<uint32_t>& grad_req = {}) {
+    std::vector<NDArrayHandle> args, auxs;
+    for (auto* a : in_args) args.push_back(a->handle());
+    for (auto* a : aux_states) auxs.push_back(a->handle());
+    std::vector<uint32_t> req =
+        grad_req.empty() ? std::vector<uint32_t>(args.size(), 0) : grad_req;
+    Check(MXExecutorBind(symbol.handle(), dev_type, dev_id,
+                         (uint32_t)args.size(), args.data(), nullptr,
+                         req.data(), (uint32_t)auxs.size(),
+                         auxs.empty() ? nullptr : auxs.data(), &handle_));
+  }
+  Executor(const Executor&) = delete;
+  Executor& operator=(const Executor&) = delete;
+  ~Executor() {
+    if (handle_) MXExecutorFree(handle_);
+  }
+
+  void Forward(bool is_train = false) {
+    Check(MXExecutorForward(handle_, is_train ? 1 : 0));
+  }
+  void Backward(const std::vector<NDArray*>& head_grads = {}) {
+    std::vector<NDArrayHandle> hg;
+    for (auto* g : head_grads) hg.push_back(g->handle());
+    Check(MXExecutorBackward(handle_, (uint32_t)hg.size(),
+                             hg.empty() ? nullptr : hg.data()));
+  }
+  std::vector<NDArray> Outputs() const {
+    uint32_t n;
+    NDArrayHandle* outs;
+    Check(MXExecutorOutputs(handle_, &n, &outs));
+    std::vector<NDArray> result;
+    for (uint32_t i = 0; i < n; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+ private:
+  ExecutorHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_HPP_

@@ -100,12 +100,17 @@ def fold_batchnorm(symbol, arg_params, aux_params):
         scale = gamma / np.sqrt(var + p["eps"])
 
         prod_params = prod.params()
+        if prod.op.name == "FullyConnected" and \
+                not prod_params.get("flatten", True):
+            # flatten=False output is (batch, ..., num_hidden): BN axis 1
+            # normalizes a sequence dim, not the FC channels — even when
+            # the sizes coincide — so the fold is never valid here
+            return None
         w_name = prod.inputs[1][0].name
         W = param_val(w_name)
         if W.shape[0] != scale.shape[0]:
             # the BN channel axis is not the producer's output-channel
-            # axis (e.g. FullyConnected with flatten=False on >2D data,
-            # where BN axis 1 normalizes the sequence dim) — not foldable
+            # axis — not foldable
             return None
         bshape = (-1,) + (1,) * (W.ndim - 1)
         new_w = W * scale.reshape(bshape)

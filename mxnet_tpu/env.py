@@ -60,6 +60,9 @@ _declare("MXNET_BACKWARD_DO_MIRROR", _parse_bool, False,
          "When true, executors run backward with jax.checkpoint-style "
          "rematerialisation to trade compute for activation memory "
          "(reference mirror option, graph_executor.cc:222-280).")
+_declare("MXNET_PS_PORT", int, 0,
+         "Port for the dist_async parameter server (kvstore_async.py); "
+         "0 = coordinator port + 512. The DMLC_PS_ROOT_PORT analogue.")
 _declare("MXNET_XLA_TPU_OPTIONS", str, "",
          "Comma-separated key=value XLA compiler options attached to every "
          "executor program when the target is a TPU (ignored on CPU). The "

@@ -37,7 +37,7 @@ import time
 import numpy as np
 
 from .base import MXNetError
-from .kvstore import KVStore, _key_str, _updater_key
+from .kvstore import KVStore, _updater_key
 
 
 def _send_msg(sock, obj):
@@ -89,12 +89,24 @@ class _PSServer:
             self._updater = updater
             self._updater_cv.notify_all()
 
-    def wait_all_done(self, timeout=120.0):
+    def wait_all_done(self, timeout=3600.0):
+        """Wait for every worker's done marker. The generous default exists
+        for straggler tolerance — the whole point of async mode; a timeout
+        is logged loudly because tearing the server down strands any
+        worker still training."""
         deadline = time.time() + timeout
         with self._done_cv:
             while self._done_count < self._num_workers:
                 left = deadline - time.time()
                 if left <= 0:
+                    import logging
+
+                    logging.warning(
+                        "dist_async server: only %d/%d workers reported "
+                        "done after %.0fs; shutting down anyway — any "
+                        "still-running worker will lose its server",
+                        self._done_count, self._num_workers, timeout,
+                    )
                     return False
                 self._done_cv.wait(left)
         return True
@@ -205,9 +217,11 @@ class AsyncDistKVStore(KVStore):
         super().__init__(kv_type)
         self._rank = int(os.environ.get("MXNET_PROC_ID", "0"))
         self._size = int(os.environ.get("MXNET_NUM_PROCS", "1"))
+        from . import env
+
         coord = os.environ.get("MXNET_COORDINATOR", "127.0.0.1:9127")
         host, _, port = coord.rpartition(":")
-        ps_port = int(os.environ.get("MXNET_PS_PORT", int(port) + 512))
+        ps_port = env.get("MXNET_PS_PORT") or int(port) + 512
         self._server = None
         if self._rank == 0:
             self._server = _PSServer(host or "127.0.0.1", ps_port, self._size)
@@ -243,10 +257,17 @@ class AsyncDistKVStore(KVStore):
         return self._sock
 
     def _rpc(self, *msg):
-        with self._sock_lock:
-            sock = self._conn()
-            _send_msg(sock, msg)
-            resp = _recv_msg(sock)
+        try:
+            with self._sock_lock:
+                sock = self._conn()
+                _send_msg(sock, msg)
+                resp = _recv_msg(sock)
+        except (ConnectionError, OSError) as e:
+            raise MXNetError(
+                f"dist_async: lost the parameter server at {self._addr} "
+                f"({e}); rank 0 may have exited or timed out waiting for "
+                "stragglers"
+            ) from e
         if resp[0] == "err":
             raise MXNetError(f"dist_async server: {resp[1]}")
         return resp[1] if len(resp) > 1 else None
@@ -261,28 +282,31 @@ class AsyncDistKVStore(KVStore):
         return self._size
 
     def init(self, key, value):
+        from .kvstore import _key_value
         from .ndarray import NDArray
 
-        keys, vals = _as_lists(key, value)
+        keys, vals = _key_value(key, value)
         for k, v in zip(keys, vals):
             arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
-            self._rpc("init", _key_str(k), arr)
+            self._rpc("init", k, arr)
 
     def push(self, key, value, priority=0):
-        from .kvstore import _merge_pushed
+        from .kvstore import _key_value, _merge_pushed
 
-        keys, vals = _as_lists(key, value)
+        keys, vals = _key_value(key, value)
         for k, v in zip(keys, vals):
             merged = _merge_pushed(v)
-            self._rpc("push", _key_str(k), np.asarray(merged.asnumpy()),
+            self._rpc("push", k, np.asarray(merged.asnumpy()),
                       self._has_optimizer)
 
     def pull(self, key, out=None, priority=0):
+        from .kvstore import _key_value
         from .ndarray import NDArray
 
-        keys, outs = _as_lists(key, out)
+        assert out is not None
+        keys, outs = _key_value(key, out)
         for k, o in zip(keys, outs):
-            arr = self._rpc("pull", _key_str(k))
+            arr = self._rpc("pull", k)
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 if isinstance(t, NDArray):
@@ -291,13 +315,35 @@ class AsyncDistKVStore(KVStore):
 
     def set_optimizer(self, optimizer):
         """Only rank 0's optimizer reaches the server (reference: worker 0
-        ships the pickled optimizer to servers, kvstore.py:238-276)."""
+        ships the pickled optimizer to servers, kvstore.py:238-276). No
+        client-side updater mirror is installed: the real optimizer state
+        lives in the server, so the base class's optimizer-state save/load
+        must keep refusing (as it does for any dist store)."""
         from . import optimizer as opt
 
-        self._updater = opt.get_updater(optimizer)  # local mirror (API)
+        self._optimizer = optimizer
         self._has_optimizer = True
         if self._server is not None:
             self._server.set_updater(opt.get_updater(optimizer))
+
+    def save_optimizer_states(self, fname):
+        raise MXNetError(
+            "Cannot save optimizer states for dist_async: the state lives "
+            "in the rank-0 server's updater (reference dist semantics)"
+        )
+
+    def load_optimizer_states(self, fname):
+        raise MXNetError(
+            "Cannot load optimizer states for dist_async: the state lives "
+            "in the rank-0 server's updater (reference dist semantics)"
+        )
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise MXNetError(
+            "row_sparse_pull is not supported on dist_async; use dist_sync "
+            "for sparse pulls (reference PullRowSparse is a sync-path "
+            "feature here)"
+        )
 
     def barrier(self):
         self._rpc("barrier")
@@ -331,7 +377,3 @@ class AsyncDistKVStore(KVStore):
             pass
 
 
-def _as_lists(key, value):
-    if isinstance(key, (list, tuple)):
-        return list(key), list(value)
-    return [key], [value]

@@ -1,0 +1,74 @@
+"""C++ binding (cpp_package/mxtpu_cpp.hpp): the reference's cpp-package
+analogue. Builds the bundled lenet_inference example against the
+amalgamated library and checks its output against the Python framework
+(the reference's cpp-package ci_test.sh pattern)."""
+
+import os
+import subprocess
+import sysconfig
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.test_utils import assert_almost_equal
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def amalgamated(tmp_path_factory):
+    out_dir = str(tmp_path_factory.mktemp("amal"))
+    r = subprocess.run(
+        ["python", os.path.join(_ROOT, "tools", "amalgamation.py"),
+         "--out-dir", out_dir],
+        capture_output=True, text=True, cwd=_ROOT,
+    )
+    assert r.returncode == 0, r.stderr
+    return out_dir
+
+
+def test_cpp_lenet_example(amalgamated, tmp_path):
+    sym = models.lenet(num_classes=10)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 1, 28, 28))],
+             label_shapes=[("softmax_label", (2,))])
+    mx.random.seed(11)
+    mod.init_params(initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "lenet")
+    mod.save_checkpoint(prefix, 0)
+
+    exe = str(tmp_path / "lenet_inference")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    r = subprocess.run(
+        ["g++", "-std=c++17", "-O2",
+         os.path.join(_ROOT, "cpp_package", "example", "lenet_inference.cc"),
+         "-o", exe, f"-I{amalgamated}",
+         f"-I{os.path.join(_ROOT, 'cpp_package')}",
+         os.path.join(amalgamated, "libmxtpu.so"),
+         f"-Wl,-rpath,{amalgamated}", f"-Wl,-rpath,{libdir}"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [exe, prefix + "-symbol.json", prefix + "-0000.params"],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr + r.stdout
+    got = np.array([float(x) for x in r.stdout.split()], np.float32)
+
+    x = (np.arange(2 * 28 * 28, dtype=np.float32) % 29 / 29.0).reshape(
+        2, 1, 28, 28)
+    mod.forward(mx.io.DataBatch([mx.nd.array(x)], []), is_train=False)
+    expect = mod.get_outputs()[0].asnumpy().ravel()
+    assert got.shape == expect.shape
+    assert_almost_equal(got, expect, rtol=1e-4, atol=1e-5)
+    # imperative surface: argmax printed on stderr
+    assert "argmax:" in r.stderr
+    want = expect.reshape(2, 10).argmax(1)
+    assert f"argmax: {want[0]} {want[1]}" in r.stderr
