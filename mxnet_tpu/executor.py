@@ -402,6 +402,8 @@ class Executor:
         """Build (lazily) the jitted program for this graph shape-signature."""
         import jax
 
+        from .parallel.mesh import current_mesh
+
         cache_key = (
             kind,
             is_train,
@@ -410,6 +412,10 @@ class Executor:
             tuple((n, self.aux_dict[n].shape, str(self.aux_dict[n].dtype)) for n in self.aux_names),
             tuple(self._wrt_names),
             tuple(sorted((n, r) for n, r in self.grad_req.items())),
+            # ops may bake the ambient mesh into the trace (RingAttention's
+            # shard_map); a program traced under one mesh context must not
+            # be served under another
+            current_mesh(),
         )
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
@@ -732,11 +738,15 @@ class Executor:
             and isinstance(states[0], list)
             and isinstance(states[1], jax.tree_util.PyTreeDef)
         )
+        from .parallel.mesh import current_mesh
+
         if flat_in:
             state_leaves, state_td = states
         else:
             state_leaves, state_td = jax.tree_util.tree_flatten(list(states))
-        plan_key = (tuple(update_names), cache_token, with_hg, state_td)
+        # the ambient mesh can be baked into the trace (see _get_jit)
+        plan_key = (tuple(update_names), cache_token, with_hg, state_td,
+                    current_mesh())
         plan = self._fused_plan.get(plan_key)
         if plan is None:
             arg_index = self.graph._arg_index

@@ -552,3 +552,41 @@ register(
     num_visible_outputs=lambda p: 2 if p["output_score"] else 1,
     aliases=("_contrib_Proposal", "proposal"),
 )
+
+
+# --- RingAttention (sequence/context parallelism as a graph op) ------------
+def _ring_attention_op(ins, params, mode):
+    """Sequence-parallel attention as a first-class symbol op.
+
+    NEW surface beyond the reference (its only long-sequence tool is
+    bucketing, SURVEY.md §2.5): q/k/v are (B, H, T, D); when a mesh with
+    the configured sequence axis is installed (``mx.parallel.with_mesh``)
+    at trace time, attention runs as blockwise ring attention — K/V blocks
+    rotate over ICI via ppermute inside the caller's jitted program
+    (parallel/ring_attention.py); without one it is exact full attention,
+    so the same symbol serves single-chip and sequence-parallel runs.
+    """
+    from ..parallel.mesh import current_mesh
+    from ..parallel.ring_attention import ring_attention_traced
+
+    q, k, v = ins
+    scale = params["scale"] if params["scale"] > 0 else None
+    return ring_attention_traced(
+        q, k, v, current_mesh(), axis=params["axis_name"],
+        causal=params["causal"], scale=scale,
+        batch_axis=params["batch_axis"] or None,
+    ).astype(q.dtype)
+
+
+register(
+    "RingAttention",
+    _ring_attention_op,
+    arg_names=["query", "key", "value"],
+    param_schema={
+        "causal": Param(parse_bool, False),
+        "axis_name": Param(parse_str, "sp"),
+        "batch_axis": Param(parse_str, ""),  # dp axis on combined meshes
+        "scale": Param(parse_float, -1.0),  # <=0: 1/sqrt(head_dim)
+    },
+    aliases=("_contrib_RingAttention",),
+)

@@ -101,26 +101,54 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
         out = _full_attention(q, k, v, causal, scale)
         return NDArray(out) if wrap else out
 
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding
 
-    spec = P(None, None, axis, None)
-    sharding = NamedSharding(mesh, spec)
+    sharding = NamedSharding(mesh, _ring_spec(axis, None))
     q = jax.device_put(q, sharding)
     k = jax.device_put(k, sharding)
     v = jax.device_put(v, sharding)
-
-    fn = jax.jit(
-        jax.shard_map(
-            functools.partial(
-                _ring_attn_shard, axis_name=axis, causal=causal, scale=scale
-            ),
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
-        )
-    )
-    out = fn(q, k, v)
+    out = jax.jit(functools.partial(
+        ring_attention_traced, mesh=mesh, axis=axis, causal=causal,
+        scale=scale,
+    ))(q, k, v)
     return NDArray(out) if wrap else out
+
+
+def _ring_spec(axis, batch_axis):
+    from jax.sharding import PartitionSpec as P
+
+    return P(batch_axis or None, None, axis, None)
+
+
+def ring_attention_traced(q, k, v, mesh, axis="sp", causal=False,
+                          scale=None, batch_axis=None):
+    """Jit-safe ring attention for use INSIDE a traced program (the
+    symbol-level ``_contrib_RingAttention`` op): placement is expressed as
+    sharding constraints (not eager ``device_put``) and the ``shard_map``
+    nests inside the caller's jit. On a combined mesh (e.g. dp×sp), pass
+    ``batch_axis`` so the batch dim keeps its data-parallel sharding
+    instead of being gathered/replicated over the other axes."""
+    from jax.sharding import NamedSharding
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if mesh is None or axis not in mesh.axis_names:
+        return _full_attention(q, k, v, causal, scale)
+    if batch_axis is not None and batch_axis not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {batch_axis!r}")
+    spec = _ring_spec(axis, batch_axis)
+    sharding = NamedSharding(mesh, spec)
+    q = jax.lax.with_sharding_constraint(q, sharding)
+    k = jax.lax.with_sharding_constraint(k, sharding)
+    v = jax.lax.with_sharding_constraint(v, sharding)
+    return jax.shard_map(
+        functools.partial(
+            _ring_attn_shard, axis_name=axis, causal=causal, scale=scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)
 
 
 def _full_attention(q, k, v, causal, scale):
