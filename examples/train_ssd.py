@@ -80,7 +80,8 @@ def main():
         min_crop_overlaps=(0.3,),
     )
 
-    net = models.ssd.get_symbol_train(num_classes=args.num_classes)
+    net = models.ssd.get_symbol_train(num_classes=args.num_classes,
+                                      data_shape=args.data_shape)
     ctx = mx.gpu(0) if mx.num_gpus() else mx.cpu()
     mod = mx.mod.Module(
         net, data_names=("data",), label_names=("label",), context=ctx,

@@ -556,6 +556,13 @@ class Executor:
         self._aux_in = self._aux_vals()
         self._fwd_rng = self._rng_key()
         self._fwd_rng_val = self._step
+        # engine read-ordering also covers AMBIENT context: the mesh in
+        # effect when forward() was CALLED governs the program (ops like
+        # RingAttention bake it into their trace), not the mesh at the
+        # lazy materialization
+        from .parallel.mesh import current_mesh
+
+        self._fwd_mesh = current_mesh()
         if self._monitor_callback is not None or self._naive:
             self._materialize_forward()  # NaiveEngine: synchronous dispatch
         else:
@@ -570,19 +577,24 @@ class Executor:
         args_in = getattr(self, "_args_in", None) or self._arg_vals()
         aux_in = getattr(self, "_aux_in", None) or self._aux_vals()
         rng = getattr(self, "_fwd_rng", None) or self._rng_key()
+        from .parallel.mesh import current_mesh, with_mesh
+
+        mesh = getattr(self, "_fwd_mesh", current_mesh())
         if self._monitor_callback is not None:
             import jax
 
-            outs, aux_upd = self.graph.evaluate(
-                args_in,
-                aux_in,
-                jax.random.fold_in(rng[0], int(rng[1])),
-                is_train,
-                monitor=self._monitor_callback,
-            )
+            with with_mesh(mesh):
+                outs, aux_upd = self.graph.evaluate(
+                    args_in,
+                    aux_in,
+                    jax.random.fold_in(rng[0], int(rng[1])),
+                    is_train,
+                    monitor=self._monitor_callback,
+                )
         else:
-            fn = self._get_jit("forward", is_train=is_train)
-            outs, aux_upd, next_step = fn(args_in, aux_in, rng)
+            with with_mesh(mesh):
+                fn = self._get_jit("forward", is_train=is_train)
+                outs, aux_upd, next_step = fn(args_in, aux_in, rng)
             self._accept_next_step(
                 next_step, getattr(self, "_fwd_rng_val", self._step)
             )
@@ -659,6 +671,9 @@ class Executor:
         self._bwd_scheduled = True
         self._bwd_rng = self._rng_key()
         self._bwd_rng_val = self._step
+        from .parallel.mesh import current_mesh
+
+        self._bwd_mesh = current_mesh()
         for n in self._wrt_names:
             self.grad_dict[n]._set_lazy(self._materialize_backward)
         for h in self._output_handles:
@@ -670,11 +685,14 @@ class Executor:
             return
         head_grads = self._bwd_heads
         with_hg = head_grads is not None
-        fn = self._get_jit("train_step", with_head_grads=with_hg)
-        outs, aux_upd, grad_map, next_step = fn(
-            self._bwd_args, self._bwd_aux, self._bwd_rng, head_grads,
-            self._bwd_prev,
-        )
+        from .parallel.mesh import current_mesh, with_mesh
+
+        with with_mesh(getattr(self, "_bwd_mesh", current_mesh())):
+            fn = self._get_jit("train_step", with_head_grads=with_hg)
+            outs, aux_upd, grad_map, next_step = fn(
+                self._bwd_args, self._bwd_aux, self._bwd_rng, head_grads,
+                self._bwd_prev,
+            )
         self._accept_next_step(
             next_step, getattr(self, "_bwd_rng_val", self._step)
         )
@@ -745,8 +763,12 @@ class Executor:
         else:
             state_leaves, state_td = jax.tree_util.tree_flatten(list(states))
         # the ambient mesh can be baked into the trace (see _get_jit)
+        # the mesh snapshotted when backward() was scheduled governs the
+        # trace (see _materialize_forward); fall back to the ambient one
+        # for direct callers
+        sched_mesh = getattr(self, "_bwd_mesh", current_mesh())
         plan_key = (tuple(update_names), cache_token, with_hg, state_td,
-                    current_mesh())
+                    sched_mesh)
         plan = self._fused_plan.get(plan_key)
         if plan is None:
             arg_index = self.graph._arg_index
@@ -830,13 +852,17 @@ class Executor:
             upd_vals, other_vals, self._bwd_aux, self._bwd_rng, head_grads,
             self._bwd_prev, state_leaves, hyper,
         )
-        if aot[0] is None:
-            # ahead-of-time compile once, then call the executable directly:
-            # the jit re-dispatch machinery (cache lookup, arg inference)
-            # costs real milliseconds per step at this argument count
-            aot[0] = fn.lower(*call_args).compile()
-        outs, aux_upd, grad_map, new_params, new_leaves, next_hyper, \
-            next_step = aot[0](*call_args)
+        from .parallel.mesh import with_mesh
+
+        with with_mesh(sched_mesh):
+            if aot[0] is None:
+                # ahead-of-time compile once, then call the executable
+                # directly: the jit re-dispatch machinery (cache lookup,
+                # arg inference) costs real milliseconds per step at this
+                # argument count
+                aot[0] = fn.lower(*call_args).compile()
+            outs, aux_upd, grad_map, new_params, new_leaves, next_hyper, \
+                next_step = aot[0](*call_args)
         self._accept_next_step(
             next_step, getattr(self, "_bwd_rng_val", self._step)
         )

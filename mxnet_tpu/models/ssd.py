@@ -44,10 +44,17 @@ def _vgg16_reduced(data):
     return layers
 
 
-def _extra_layers(body):
+def _extra_layers(body, fsize):
+    """Extra feature scales; only the stages the input size supports are
+    built (SSD-300's full spec needs ~300px — smaller inputs drop tail
+    scales instead of inferring 0-sized feature maps; the reference ships
+    per-size symbol variants, ssd_300/ssd_512, for the same reason)."""
     layers = []
     specs = [(256, 512, 2), (128, 256, 2), (128, 256, 1), (128, 256, 1)]
     for i, (f1, f2, stride) in enumerate(specs):
+        nxt = (fsize - 1) // 2 + 1 if stride == 2 else fsize - 2
+        if nxt < 1:
+            break
         body = _conv_act(body, f"multi_feat_{i}_conv_1x1", f1, kernel=(1, 1),
                          pad=(0, 0))
         body = _conv_act(
@@ -55,6 +62,7 @@ def _extra_layers(body):
             pad=(1, 1) if stride == 2 else (0, 0), stride=(stride, stride),
         )
         layers.append(body)
+        fsize = nxt
     return layers
 
 
@@ -107,21 +115,23 @@ def multibox_layer(from_layers, num_classes, sizes=_SIZES, ratios=_RATIOS,
     return loc_preds, cls_preds, anchor_boxes
 
 
-def _heads(num_classes):
+def _heads(num_classes, data_shape=300):
     data = sym.Variable("data")
     backbone = _vgg16_reduced(data)
     conv4_3, fc7 = backbone
     conv4_3_norm = sym.L2Normalization(conv4_3, mode="channel",
                                        name="conv4_3_norm") * 20.0
-    extras = _extra_layers(fc7)
+    extras = _extra_layers(fc7, data_shape // 16)
     from_layers = [conv4_3_norm, fc7] + extras
-    return multibox_layer(from_layers, num_classes)
+    n = len(from_layers)
+    return multibox_layer(from_layers, num_classes,
+                          sizes=_SIZES[:n], ratios=_RATIOS[:n])
 
 
-def get_symbol_train(num_classes=20, **kwargs):
+def get_symbol_train(num_classes=20, data_shape=300, **kwargs):
     """Training symbol (reference symbol_builder.get_symbol_train)."""
     label = sym.Variable("label")
-    loc_preds, cls_preds, anchor_boxes = _heads(num_classes)
+    loc_preds, cls_preds, anchor_boxes = _heads(num_classes, data_shape)
 
     tmp = sym.MultiBoxTarget(
         anchor_boxes, label, cls_preds, overlap_threshold=0.5,
@@ -155,9 +165,9 @@ def get_symbol_train(num_classes=20, **kwargs):
 
 
 def get_symbol(num_classes=20, nms_thresh=0.5, force_suppress=False,
-               nms_topk=400, **kwargs):
+               nms_topk=400, data_shape=300, **kwargs):
     """Inference symbol (reference symbol_builder.get_symbol)."""
-    loc_preds, cls_preds, anchor_boxes = _heads(num_classes)
+    loc_preds, cls_preds, anchor_boxes = _heads(num_classes, data_shape)
     cls_prob = sym.SoftmaxActivation(cls_preds, mode="channel",
                                      name="cls_prob")
     return sym.MultiBoxDetection(

@@ -107,11 +107,18 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
     q = jax.device_put(q, sharding)
     k = jax.device_put(k, sharding)
     v = jax.device_put(v, sharding)
-    out = jax.jit(functools.partial(
+    out = _jitted_ring(mesh, axis, causal, float(scale))(q, k, v)
+    return NDArray(out) if wrap else out
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_ring(mesh, axis, causal, scale):
+    """Compiled eager entry, cached per config — a fresh jit(partial(...))
+    per call would retrace and recompile the ring every invocation."""
+    return jax.jit(functools.partial(
         ring_attention_traced, mesh=mesh, axis=axis, causal=causal,
         scale=scale,
-    ))(q, k, v)
-    return NDArray(out) if wrap else out
+    ))
 
 
 def _ring_spec(axis, batch_axis):

@@ -178,3 +178,26 @@ def test_ring_attention_mesh_not_baked_into_cache():
             out_ring._data.sharding
         np.testing.assert_allclose(out_ring.asnumpy(), out_plain,
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_mesh_snapshotted_at_schedule_time():
+    """Engine read-ordering covers the ambient mesh: forward() called
+    INSIDE with_mesh must run the ring program even when the lazy output
+    is first read after the context exits."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+
+    B, H, T, D = 1, 1, 16, 4
+    rng = np.random.RandomState(2)
+    qn = rng.randn(B, H, T, D).astype(np.float32)
+    net = mx.sym.RingAttention(
+        mx.sym.Variable("q"), mx.sym.Variable("k"), mx.sym.Variable("v"),
+        name="attn")
+    exe = net.simple_bind(mx.cpu(), grad_req="null",
+                          q=(B, H, T, D), k=(B, H, T, D), v=(B, H, T, D))
+    for n in ("q", "k", "v"):
+        exe.arg_dict[n][:] = qn
+    with parallel.with_mesh(parallel.make_mesh({"sp": 8})):
+        out = exe.forward(is_train=False)[0]
+    # materialize OUTSIDE the context: the scheduled mesh must govern
+    assert "sp" in str(out._data.sharding.spec), out._data.sharding
