@@ -1,0 +1,182 @@
+"""Caffe prototxt -> Symbol converter (tools/caffe_converter.py; the
+reference tools/caffe_converter/convert_symbol.py analogue)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+from caffe_converter import convert_symbol, parse_prototxt  # noqa: E402
+
+_LENET_PROTOTXT = """
+name: "LeNet"
+input: "data"
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 20 kernel_size: 5 stride: 1 }
+}
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "conv2"
+  type: "Convolution"
+  bottom: "pool1"
+  top: "conv2"
+  convolution_param { num_output: 50 kernel_size: 5 stride: 1 }
+}
+layer {
+  name: "pool2"
+  type: "Pooling"
+  bottom: "conv2"
+  top: "pool2"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "ip1"
+  type: "InnerProduct"
+  bottom: "pool2"
+  top: "ip1"
+  inner_product_param { num_output: 500 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer {
+  name: "ip2"
+  type: "InnerProduct"
+  bottom: "ip1"
+  top: "ip2"
+  inner_product_param { num_output: 10 }
+}
+layer {
+  name: "loss"
+  type: "SoftmaxWithLoss"
+  bottom: "ip2"
+  bottom: "label"
+  top: "loss"
+}
+"""
+
+
+def test_parse_prototxt_structure():
+    net = parse_prototxt(_LENET_PROTOTXT)
+    assert net["name"] == "LeNet"
+    layers = net["layer"]
+    assert len(layers) == 8
+    assert layers[0]["convolution_param"]["num_output"] == 20
+    assert layers[1]["pooling_param"]["pool"] == "MAX"
+    assert layers[-1]["bottom"] == ["ip2", "label"]
+
+
+def test_convert_lenet_trains():
+    sym, input_name = convert_symbol(_LENET_PROTOTXT)
+    assert input_name == "data"
+    args = sym.list_arguments()
+    assert "conv1_weight" in args and "ip2_bias" in args
+
+    # converted LeNet must train end to end on synthetic digits
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 10, 128).astype(np.float32)
+    # separable by mean brightness: class c images sit at intensity c/10
+    x = (rng.rand(128, 1, 28, 28) * 0.1
+         + y[:, None, None, None] / 10.0).astype(np.float32)
+    it = mx.io.NDArrayIter(x, {"label": y}, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(sym, context=mx.cpu(), data_names=("data",),
+                        label_names=("label",))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.002})
+    metric = mx.metric.Accuracy()
+    for epoch in range(25):
+        it.reset()
+        metric.reset()
+        for b in it:
+            mod.forward_backward(b)
+            mod.update()
+            mod.update_metric(metric, b.label)
+    assert metric.get()[1] > 0.8, metric.get()
+
+
+def test_convert_vgg_style_blocks_and_eltwise():
+    proto = """
+    input: "data"
+    layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+            convolution_param { num_output: 8 kernel_size: 3 pad: 1 } }
+    layer { name: "r1" type: "ReLU" bottom: "c1" top: "c1" }
+    layer { name: "c2" type: "Convolution" bottom: "c1" top: "c2"
+            convolution_param { num_output: 8 kernel_size: 3 pad: 1 } }
+    layer { name: "sum" type: "Eltwise" bottom: "c1" bottom: "c2" top: "sum" }
+    layer { name: "gp" type: "Pooling" bottom: "sum" top: "gp"
+            pooling_param { pool: AVE global_pooling: true } }
+    layer { name: "fc" type: "InnerProduct" bottom: "gp" top: "fc"
+            inner_product_param { num_output: 4 } }
+    layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+    """
+    sym, _ = convert_symbol(proto)
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(2, 3, 16, 16))
+    rng = np.random.RandomState(1)
+    for n, a in exe.arg_dict.items():
+        if n != "data":
+            a[:] = rng.uniform(-0.1, 0.1, a.shape).astype(np.float32)
+    exe.arg_dict["data"][:] = rng.rand(2, 3, 16, 16).astype(np.float32)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (2, 4)
+    assert np.allclose(out.sum(1), 1.0, atol=1e-4)
+
+
+def test_convert_training_prototxt_with_data_layer_and_bn():
+    """Real-world shapes: a Data layer with data AND label tops, lowercase
+    boolean tokens, BatchNorm+Scale pairs, and Eltwise coeffs."""
+    proto = """
+    layer { name: "mnist" type: "Data" top: "data" top: "label" }
+    layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+            convolution_param { num_output: 8 kernel_size: 3 pad: 1
+                                bias_term: false } }
+    layer { name: "bn1" type: "BatchNorm" bottom: "c1" top: "c1" }
+    layer { name: "sc1" type: "Scale" bottom: "c1" top: "c1" }
+    layer { name: "r1" type: "ReLU" bottom: "c1" top: "c1" }
+    layer { name: "c2" type: "Convolution" bottom: "c1" top: "c2"
+            convolution_param { num_output: 8 kernel_size: 3 pad: 1 } }
+    layer { name: "diff" type: "Eltwise" bottom: "c1" bottom: "c2" top: "diff"
+            eltwise_param { operation: SUM coeff: 1 coeff: -1 } }
+    layer { name: "gp" type: "Pooling" bottom: "diff" top: "gp"
+            pooling_param { pool: AVE global_pooling: true } }
+    layer { name: "fc" type: "InnerProduct" bottom: "gp" top: "fc"
+            inner_product_param { num_output: 3 } }
+    layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc"
+            bottom: "label" top: "loss" }
+    """
+    sym, input_name = convert_symbol(proto)
+    assert input_name == "data"
+    args = sym.list_arguments()
+    assert "label" in args          # the Data layer's second top
+    assert "c1_weight" in args and "c1_bias" not in args  # bias_term false
+    assert "bn1_gamma" in args      # learnable (Scale folded, fix_gamma off)
+    exe = sym.simple_bind(mx.cpu(), data=(2, 3, 8, 8), label=(2,))
+    rng = np.random.RandomState(0)
+    for n, a in exe.arg_dict.items():
+        if n not in ("data", "label"):
+            a[:] = rng.uniform(-0.2, 0.2, a.shape).astype(np.float32)
+    exe.arg_dict["data"][:] = rng.rand(2, 3, 8, 8).astype(np.float32)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (2, 3) and np.allclose(out.sum(1), 1, atol=1e-4)
+
+    # standalone Scale refuses loudly
+    with pytest.raises(ValueError):
+        convert_symbol("""
+        input: "data"
+        layer { name: "s" type: "Scale" bottom: "data" top: "s" }
+        """)

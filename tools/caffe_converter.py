@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""Caffe prototxt -> Symbol converter.
+
+Reference: ``tools/caffe_converter/convert_symbol.py`` (parses a Caffe
+network definition and emits the equivalent mx.symbol graph; its sibling
+``convert_model.py`` additionally converts ``.caffemodel`` weights, which
+requires the Caffe protobuf runtime and is out of scope here — weights
+import via the standard ``.params`` path instead).
+
+The prototxt text-protobuf format is parsed directly (no protobuf
+dependency): both the modern ``layer {}`` and legacy ``layers {}`` blocks,
+string and enum layer types. Supported layers: Convolution, InnerProduct,
+Pooling (MAX/AVE, global), ReLU, LRN, Dropout, Concat, Eltwise (SUM),
+BatchNorm (+ following Scale folded in), Flatten, Softmax /
+SoftmaxWithLoss, Accuracy (skipped), Data/Input (becomes the data
+Variable). In-place layers (same top as bottom) chain naturally.
+
+Usage:
+    python tools/caffe_converter.py net.prototxt [-o out-symbol.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+# ---------------------------------------------------------------------------
+# text-protobuf parsing
+# ---------------------------------------------------------------------------
+_TOKEN = re.compile(
+    r"""
+    (?P<brace_open>\{)|(?P<brace_close>\})|
+    (?P<key>[A-Za-z_][A-Za-z0-9_]*)\s*(?P<colon>:)?\s*
+    (?P<value>"[^"]*"|[-+0-9.eE]+|[A-Za-z_][A-Za-z0-9_]*)?
+    """,
+    re.VERBOSE,
+)
+
+
+def parse_prototxt(text):
+    """Parse text protobuf into nested dicts; repeated keys become lists."""
+    # strip comments
+    text = re.sub(r"#[^\n]*", "", text)
+    pos = 0
+    n = len(text)
+
+    def parse_block():
+        nonlocal pos
+        out = {}
+
+        def add(key, value):
+            if key in out:
+                if not isinstance(out[key], list):
+                    out[key] = [out[key]]
+                out[key].append(value)
+            else:
+                out[key] = value
+
+        while pos < n:
+            m = _TOKEN.match(text, pos)
+            if m is None or m.end() == pos:
+                pos += 1
+                continue
+            if m.group("brace_close"):
+                pos = m.end()
+                return out
+            key = m.group("key")
+            if key is None:
+                pos = m.end()
+                continue
+            pos = m.end()
+            # block: `key { ... }` (colon-less, value may have matched the
+            # brace-opening of the block body — rewind in that case)
+            rest = text[pos:].lstrip()
+            if m.group("colon") is None or m.group("value") is None:
+                brace = text.find("{", m.start())
+                if brace != -1 and text[m.end("key"):brace].strip() in ("", ":"):
+                    pos = brace + 1
+                    add(key, parse_block())
+                    continue
+            val = m.group("value")
+            if val is None:
+                continue
+            if val.startswith('"'):
+                add(key, val[1:-1])
+            elif val in ("true", "false"):  # prototxt boolean tokens
+                add(key, val == "true")
+            else:
+                try:
+                    add(key, int(val))
+                except ValueError:
+                    try:
+                        add(key, float(val))
+                    except ValueError:
+                        add(key, val)  # enum token
+        return out
+
+    return parse_block()
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+# ---------------------------------------------------------------------------
+# layer mapping
+# ---------------------------------------------------------------------------
+def _kernel(p):
+    k = p.get("kernel_size", p.get("kernel_h"))
+    kh = p.get("kernel_h", k)
+    kw = p.get("kernel_w", k)
+    return (int(kh), int(kw))
+
+
+def _stride(p):
+    s = p.get("stride", 1)
+    return (int(p.get("stride_h", s)), int(p.get("stride_w", s)))
+
+
+def _pad(p):
+    d = p.get("pad", 0)
+    return (int(p.get("pad_h", d)), int(p.get("pad_w", d)))
+
+
+def convert_symbol(prototxt_text):
+    """Return (symbol, input_name) for a Caffe network definition."""
+    import mxnet_tpu as mx
+
+    net = parse_prototxt(prototxt_text)
+    layers = _as_list(net.get("layer")) + _as_list(net.get("layers"))
+    tops = {}  # blob name -> Symbol
+    input_name = "data"
+    for iname in _as_list(net.get("input")):
+        input_name = iname
+        tops[iname] = mx.sym.Variable(iname)
+
+    def get_bottom(layer):
+        bots = _as_list(layer.get("bottom"))
+        syms = []
+        for b in bots:
+            if b not in tops:
+                tops[b] = mx.sym.Variable(b)
+            syms.append(tops[b])
+        return syms
+
+    last = None
+    bn_tops = set()  # blobs produced by BatchNorm (Scale folds into them)
+    for layer in layers:
+        ltype = str(layer.get("type", "")).upper()
+        name = layer.get("name", ltype.lower())
+        top = _as_list(layer.get("top"))
+        bottoms = get_bottom(layer)
+        b0 = bottoms[0] if bottoms else None
+
+        if ltype in ("DATA", "INPUT", "MEMORYDATA", "IMAGEDATA", "HDF5DATA"):
+            # each top is its own blob (train prototxts emit data AND label)
+            input_name = top[0] if top else "data"
+            for t in top or ["data"]:
+                tops[t] = mx.sym.Variable(t)
+            last = tops[input_name]
+            continue
+        elif ltype == "CONVOLUTION":
+            p = layer.get("convolution_param", {})
+            out = mx.sym.Convolution(
+                b0, num_filter=int(p["num_output"]), kernel=_kernel(p),
+                stride=_stride(p), pad=_pad(p),
+                num_group=int(p.get("group", 1)),
+                no_bias=not bool(p.get("bias_term", 1)), name=name,
+            )
+        elif ltype in ("INNERPRODUCT", "INNER_PRODUCT"):
+            p = layer.get("inner_product_param", {})
+            out = mx.sym.FullyConnected(
+                b0, num_hidden=int(p["num_output"]),
+                no_bias=not bool(p.get("bias_term", 1)), name=name,
+            )
+        elif ltype == "POOLING":
+            p = layer.get("pooling_param", {})
+            pool = str(p.get("pool", "MAX")).upper()
+            pmap = {"MAX": "max", "AVE": "avg", "0": "max", "1": "avg"}
+            if str(pool) not in pmap:
+                raise ValueError(
+                    f"caffe_converter: unsupported pooling method {pool!r} "
+                    f"(layer {name!r})"
+                )
+            ptype = pmap[str(pool)]
+            if p.get("global_pooling"):
+                out = mx.sym.Pooling(b0, global_pool=True, kernel=(1, 1),
+                                     pool_type=ptype, name=name)
+            else:
+                out = mx.sym.Pooling(
+                    b0, kernel=_kernel(p), stride=_stride(p), pad=_pad(p),
+                    pool_type=ptype,
+                    pooling_convention="full",  # caffe ceil-mode windows
+                    name=name,
+                )
+        elif ltype == "RELU":
+            out = mx.sym.Activation(b0, act_type="relu", name=name)
+        elif ltype == "SIGMOID":
+            out = mx.sym.Activation(b0, act_type="sigmoid", name=name)
+        elif ltype == "TANH":
+            out = mx.sym.Activation(b0, act_type="tanh", name=name)
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            out = mx.sym.LRN(
+                b0, alpha=float(p.get("alpha", 1e-4)),
+                beta=float(p.get("beta", 0.75)),
+                knorm=float(p.get("k", 1.0)),
+                nsize=int(p.get("local_size", 5)), name=name,
+            )
+        elif ltype == "DROPOUT":
+            p = layer.get("dropout_param", {})
+            out = mx.sym.Dropout(
+                b0, p=float(p.get("dropout_ratio", 0.5)), name=name)
+        elif ltype == "CONCAT":
+            p = layer.get("concat_param", {})
+            out = mx.sym.Concat(*bottoms, dim=int(p.get("axis", 1)),
+                                name=name)
+        elif ltype == "ELTWISE":
+            p = layer.get("eltwise_param", {})
+            op = str(p.get("operation", "SUM")).upper()
+            if op in ("SUM", "1"):
+                coeffs = [float(c) for c in _as_list(p.get("coeff"))]
+                coeffs += [1.0] * (len(bottoms) - len(coeffs))
+                terms = [b if c == 1.0 else b * c
+                         for b, c in zip(bottoms, coeffs)]
+                out = terms[0]
+                for t in terms[1:]:
+                    out = out + t
+            elif op in ("PROD", "0"):
+                out = bottoms[0]
+                for b in bottoms[1:]:
+                    out = out * b
+            elif op in ("MAX", "2"):
+                out = bottoms[0]
+                for b in bottoms[1:]:
+                    out = mx.sym.maximum(out, b)
+            else:
+                raise ValueError(
+                    f"caffe_converter: unsupported eltwise op {op!r} "
+                    f"(layer {name!r})"
+                )
+        elif ltype == "BATCHNORM":
+            p = layer.get("batch_norm_param", {})
+            # fix_gamma=False: the paired caffe Scale layer's learnable
+            # gamma/beta live IN the BatchNorm symbol (the reference
+            # converter folds them the same way, convert_symbol.py)
+            out = mx.sym.BatchNorm(
+                b0, eps=float(p.get("eps", 1e-5)), fix_gamma=False,
+                use_global_stats=bool(p.get("use_global_stats", 0)),
+                name=name,
+            )
+            bn_tops.update(top or [name])
+        elif ltype == "SCALE":
+            # folds into the preceding BatchNorm's gamma/beta; a standalone
+            # Scale has no such home and silent identity would be wrong
+            bot_name = _as_list(layer.get("bottom"))
+            if not bot_name or bot_name[0] not in bn_tops:
+                raise ValueError(
+                    f"caffe_converter: standalone Scale layer {name!r} is "
+                    "not supported (only BatchNorm+Scale pairs fold)"
+                )
+            out = b0
+        elif ltype == "FLATTEN":
+            out = mx.sym.Flatten(b0, name=name)
+        elif ltype in ("SOFTMAX", "SOFTMAXWITHLOSS", "SOFTMAX_LOSS"):
+            if len(bottoms) > 1:
+                out = mx.sym.SoftmaxOutput(b0, bottoms[1], name=name)
+            else:
+                out = mx.sym.SoftmaxOutput(b0, name=name)
+        elif ltype == "ACCURACY":
+            continue  # evaluation-only layer
+        else:
+            raise ValueError(
+                f"caffe_converter: unsupported layer type {ltype!r} "
+                f"(layer {name!r})"
+            )
+        for t in top or [name]:
+            tops[t] = out
+        last = out
+    if last is None:
+        raise ValueError("no layers found in prototxt")
+    return last, input_name
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("prototxt")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write symbol JSON here (default: stdout)")
+    args = ap.parse_args()
+    import os
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    with open(args.prototxt) as f:
+        symbol, _ = convert_symbol(f.read())
+    js = symbol.tojson()
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(js)
+    else:
+        print(js)
+
+
+if __name__ == "__main__":
+    main()
