@@ -378,13 +378,21 @@ def _batch_norm(ins, params, mode):
         # into a single read of the activation (jnp.mean followed by jnp.var
         # chains two full passes — the dominant cost of training BN on a
         # bandwidth-bound chip). Plain E[x^2]-E[x]^2 catastrophically cancels
-        # in fp32 when |mean| >> std, so the pass is shifted by the moving
-        # mean — a free, gradient-neutral anchor that tracks the batch mean:
-        # var = E[(x-m0)^2] - (mean-m0)^2 with m0 = stop_grad(moving_mean).
-        # fp32 accumulation happens inside the fused reduce; no fp32 copy of
-        # the activation is materialised.
+        # in fp32 when |mean| >> std, so the pass is shifted by an anchor m0:
+        # var = E[(x-m0)^2] - (mean-m0)^2, exact for any m0. The anchor is
+        # the per-channel mean of a thin probe slice of the batch itself —
+        # it tracks the batch mean to O(std) no matter how stale the moving
+        # stats are (zero-init, fresh checkpoint on shifted data), so the
+        # subtracted term stays O(var) and cannot cancel. The probe slices a
+        # spatial axis, not the batch axis, so under a batch-sharded mesh it
+        # reads evenly from every shard instead of gathering sample 0 from
+        # one device. fp32 accumulation happens inside the fused reduce; no
+        # fp32 copy of the activation is materialised.
         n = float(np.prod([data.shape[i] for i in axes]))
-        m0 = jax.lax.stop_gradient(moving_mean).astype(jnp.float32)
+        probe = jax.lax.slice_in_dim(data, 0, 1, axis=2 if data.ndim > 2 else 0)
+        m0 = jax.lax.stop_gradient(
+            jnp.mean(probe.astype(jnp.float32), axis=axes)
+        )
         xc = data.astype(jnp.float32) - m0.reshape(bshape)
         dmean = jnp.sum(xc, axis=axes) / n
         mean = m0 + dmean

@@ -185,6 +185,30 @@ def test_batchnorm_train_stats():
     assert_almost_equal(mm, 0.1 * x.mean(axis=(0, 2, 3)), rtol=1e-4, atol=1e-5)
 
 
+def test_batchnorm_stale_anchor_precision():
+    # Regression: one-pass shifted variance must stay accurate when the
+    # moving stats are stale relative to the data — zero-init moving_mean
+    # with |mean| >> std is the worst case for E[x^2]-E[x]^2 cancellation.
+    mean, std = 1000.0, 0.1
+    x = (mean + std * rs.randn(8, 3, 16, 16)).astype(np.float32)
+    bn = mx.sym.BatchNorm(
+        mx.sym.Variable("x"), name="bn", fix_gamma=False, eps=1e-6
+    )
+    exe = bn.simple_bind(ctx=mx.cpu(), x=x.shape)
+    exe.arg_dict["bn_gamma"][:] = 1.0
+    exe.arg_dict["bn_beta"][:] = 0.0
+    # aux moving_mean/var keep their zero/one init: maximally stale anchor
+    exe.forward(is_train=True, x=mx.nd.array(x))
+    out = exe.outputs[0].asnumpy()
+    assert_almost_equal(out.mean(axis=(0, 2, 3)), np.zeros(3), atol=1e-3)
+    ref_var = x.astype(np.float64).var(axis=(0, 2, 3))
+    assert_almost_equal(out.var(axis=(0, 2, 3)), np.ones(3), rtol=0.05)
+    # the internally-computed batch variance must match a float64 oracle
+    exe.backward(mx.nd.ones(out.shape))
+    mv = exe.aux_dict["bn_moving_var"].asnumpy()
+    assert_almost_equal(mv, 0.9 * 1.0 + 0.1 * ref_var, rtol=2e-2)
+
+
 def test_softmax_output_grad():
     x = rs.randn(4, 5).astype(np.float32)
     label = np.array([0, 1, 2, 3], dtype=np.float32)
