@@ -60,6 +60,10 @@ _declare("MXNET_BACKWARD_DO_MIRROR", _parse_bool, False,
          "When true, executors run backward with jax.checkpoint-style "
          "rematerialisation to trade compute for activation memory "
          "(reference mirror option, graph_executor.cc:222-280).")
+_declare("MXNET_PP_MICROBATCHES", int, 0,
+         "GPipe microbatch count used when SequentialModule lowers to the "
+         "pipeline schedule under a 'pp' mesh axis; 0 = the pp degree. "
+         "Constructor arg pipeline_microbatches takes precedence.")
 _declare("MXNET_PS_PORT", int, 0,
          "Port for the dist_async parameter server (kvstore_async.py); "
          "0 = coordinator port + 512. The DMLC_PS_ROOT_PORT analogue.")

@@ -1,0 +1,525 @@
+"""Pipeline parallelism as a Module-API feature.
+
+``SequentialModule`` lowers to the GPipe schedule here when a mesh with a
+``pp`` axis is installed at bind time — the same promotion the Symbol-level
+``__shard__`` attribute gave tensor parallelism. The reference's nearest
+"usable from user code" analogue is its model-parallel LSTM
+(``example/model-parallel-lstm/lstm.py``), which places layers on devices
+with ``group2ctx`` but has no microbatch schedule; SURVEY.md §2.5 marks
+scheduled pipelining absent upstream, so the schedule itself is TPU-native
+surface: one jitted SPMD program, a ``lax.scan`` over pipeline ticks with
+``lax.ppermute`` hops, differentiated end-to-end by ``jax.grad`` (GPipe
+fill/drain bubbles included; grads/loss match the serial execution
+exactly, which the tests assert).
+
+Two lowerings, picked automatically:
+
+* **stacked** — every stage is structurally identical (a homogeneous
+  label-free block stack): per-stage parameters are stacked on a leading
+  axis and sharded ``P('pp')``, so each pipeline rank holds only its
+  slice.
+* **composed** — heterogeneous stages (the common case: distinct layers,
+  loss head on the last stage): each tick dispatches this rank's stage
+  with ``lax.switch`` over per-stage branch closures. Parameters are
+  replicated over the mesh — correct, but each device holds every stage's
+  weights; stack your repeated blocks into homogeneous stages if that
+  matters.
+
+Scope (enforced with clear errors): every child is a plain bound
+``Module`` with one data input, interior boundaries are single tensors of
+one shared shape/dtype, only the last child takes labels, and the child
+count equals the ``pp`` axis size. BatchNorm-style aux states update from
+the final microbatch's tick only (per-microbatch aux updates have no
+serial meaning under GPipe).
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+
+
+def _graph_signature(graph, data_names, label_names, shape_of):
+    """Structural signature for homogeneity detection: op types, attrs,
+    wiring and bound variable shapes/dtypes, with names erased; data/label
+    inputs marked by role. Shapes matter — structurally identical stages
+    with different bound widths cannot stack."""
+    index = {}
+    sig = []
+    for i, node in enumerate(graph.topo):
+        index[id(node)] = i
+        if node.is_variable:
+            role = ("data" if node.name in data_names
+                    else "label" if node.name in label_names
+                    else "aux" if node.is_aux else "param")
+            sig.append(("var", role) + shape_of(node.name, node.is_aux))
+        else:
+            params = tuple(sorted((k, str(v)) for k, v in
+                           (node.params() or {}).items()))
+            wiring = tuple((index[id(n)], ix) for (n, ix) in node.inputs)
+            sig.append((node.op.name, params, wiring))
+    heads = tuple((index[id(n)], ix) for (n, ix) in graph.heads)
+    return (tuple(sig), heads)
+
+
+class _StageInfo:
+    def __init__(self, module, takes_labels):
+        self.module = module
+        self.takes_labels = takes_labels
+        exe = module._exec_group._exec
+        self.exec_ = exe
+        self.graph = exe.graph
+        self.data_name = module._data_names[0]
+        self.label_names = list(module._label_names) if takes_labels else []
+        self.param_names = [n for n in self.graph.arg_names
+                            if n != self.data_name
+                            and n not in self.label_names]
+        self.aux_names = list(self.graph.aux_names)
+
+
+def _build_stages(stages):
+    infos = []
+    for i, st in enumerate(stages):
+        mod = st.module
+        if getattr(mod, "_exec_group", None) is None:
+            raise MXNetError(
+                f"pipeline stage {i} is not a bound plain Module; pipelined "
+                "SequentialModule supports Module children only"
+            )
+        if len(mod._data_names) != 1:
+            raise MXNetError(
+                f"pipeline stage {i} has {len(mod._data_names)} data "
+                "inputs; the GPipe boundary carries exactly one activation"
+            )
+        if st.takes_labels and i != len(stages) - 1:
+            raise MXNetError(
+                "only the last pipeline stage may take labels (the loss "
+                f"head); stage {i} sets take_labels"
+            )
+        req = mod._grad_req
+        reqs = set(req.values()) if isinstance(req, dict) else \
+            set(req) if isinstance(req, (list, tuple)) else {req}
+        if "add" in reqs:
+            raise MXNetError(
+                "grad_req='add' accumulation is not supported by the "
+                "pipelined SequentialModule (each step writes fresh "
+                f"gradients); stage {i} requests it"
+            )
+        infos.append(_StageInfo(mod, st.takes_labels))
+    return infos
+
+
+class PipelineEngine:
+    """Owns the jitted GPipe program(s) for one bound SequentialModule."""
+
+    def __init__(self, stages, mesh, num_microbatches, batch_size, logger):
+        from ..env import get as env_get
+
+        self.mesh = mesh
+        self.S = int(mesh.shape["pp"])
+        if self.S < 2:
+            raise MXNetError("a pp mesh axis of size 1 pipelines nothing; "
+                             "drop the pp axis or grow it")
+        if len(stages) != self.S:
+            raise MXNetError(
+                f"{len(stages)} pipeline stages for a pp axis of size "
+                f"{self.S}; they must match (group layers per stage)"
+            )
+        self.infos = _build_stages(stages)
+        self.M = int(num_microbatches or env_get("MXNET_PP_MICROBATCHES")
+                     or self.S)
+        if batch_size % self.M != 0:
+            raise MXNetError(
+                f"batch {batch_size} not divisible into {self.M} "
+                "microbatches"
+            )
+        self.logger = logger
+        shapes = set()
+        for info in self.infos[:-1]:
+            outs = info.module.output_shapes
+            if len(outs) != 1:
+                raise MXNetError(
+                    f"interior pipeline stage {info.module} has "
+                    f"{len(outs)} outputs; exactly one activation crosses "
+                    "a GPipe boundary"
+                )
+            shapes.add((outs[0][1][0] // self.M,) + tuple(outs[0][1][1:]))
+        if len(shapes) > 1:
+            raise MXNetError(
+                f"interior boundary shapes differ across stages: "
+                f"{sorted(shapes)}; the pipeline ring buffer needs one "
+                "shape (pad or restructure stages)"
+            )
+        def shape_of(info):
+            def f(name, is_aux):
+                d = info.exec_.aux_dict if is_aux else info.exec_.arg_dict
+                arr = d.get(name)
+                return (tuple(arr.shape), str(arr.dtype)) if arr is not None \
+                    else ((), "?")
+            return f
+
+        sigs = [_graph_signature(info.graph, {info.data_name},
+                                 set(info.label_names), shape_of(info))
+                for info in self.infos]
+        self.homogeneous = self.S > 1 and all(s == sigs[0] for s in sigs[1:])
+        from ..executor import _head_loss_flags
+
+        self.has_loss = any(_head_loss_flags(self.infos[-1].graph))
+        self._programs = {}
+        self._last_outputs = None
+        self._rng_dev = None
+
+    # -- value plumbing ---------------------------------------------------
+    def _stage_vals(self):
+        """Current (param_vals, aux_vals) per stage from the child execs."""
+        pvals, avals = [], []
+        for info in self.infos:
+            exe = info.exec_
+            pvals.append(tuple(exe.arg_dict[n]._data
+                               for n in info.param_names))
+            avals.append(tuple(exe.aux_dict[n]._data
+                               for n in info.aux_names))
+        return tuple(pvals), tuple(avals)
+
+    # -- program construction --------------------------------------------
+    def _program(self, is_train, with_grads):
+        import jax
+
+        key = (bool(is_train), bool(with_grads))
+        if key not in self._programs:
+            self._programs[key] = jax.jit(self._make_step(*key))
+        return self._programs[key]
+
+    def _make_step(self, is_train, with_grads):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..executor import _head_loss_flags
+
+        mesh, S, M = self.mesh, self.S, self.M
+        infos = self.infos
+        homogeneous = self.homogeneous
+        dp = "dp" if "dp" in mesh.axis_names else None
+        loss_flags = _head_loss_flags(infos[-1].graph)
+        num_heads = len(infos[-1].graph.heads)
+
+        def run_stage(i, a_in, labels_mb, pvals_i, avals_i, key):
+            info = infos[i]
+            full = []
+            for n in info.graph.arg_names:
+                if n == info.data_name:
+                    full.append(a_in)
+                elif n in info.label_names:
+                    full.append(labels_mb[info.label_names.index(n)])
+                else:
+                    full.append(pvals_i[info.param_names.index(n)])
+            outs, aux_upd = info.graph.evaluate(
+                full, list(avals_i), jax.random.fold_in(key, i), is_train
+            )
+            return outs, tuple(aux_upd)
+
+        def sched(pvals, avals, rng, xs, ls):
+            s = jax.lax.axis_index("pp")
+            key0 = jax.random.PRNGKey(0)
+
+            def first_stage_out(a):
+                pv = (jax.tree_util.tree_map(lambda v: v[0], pvals)
+                      if homogeneous else pvals[0])
+                av = (jax.tree_util.tree_map(lambda v: v[0], avals)
+                      if homogeneous else avals[0])
+                return run_stage(0, a, (), pv, av, key0)[0][0]
+
+            ring_aval = jax.eval_shape(first_stage_out, xs[0])
+
+            def last_stage_outs(a, lm):
+                pv = (jax.tree_util.tree_map(lambda v: v[0], pvals)
+                      if homogeneous else pvals[S - 1])
+                av = (jax.tree_util.tree_map(lambda v: v[0], avals)
+                      if homogeneous else avals[S - 1])
+                return run_stage(S - 1, a, lm, pv, av, key0)[0]
+
+            head_avals = jax.eval_shape(
+                last_stage_outs,
+                jax.ShapeDtypeStruct(ring_aval.shape, ring_aval.dtype),
+                tuple(l[0] for l in ls),
+            )
+            zero_ring = jnp.zeros(ring_aval.shape, ring_aval.dtype)
+            outs0 = tuple(jnp.zeros((M,) + tuple(h.shape), h.dtype)
+                          for h in head_avals)
+            if homogeneous:
+                # keep the (local, size-1) stacked leading axis so the
+                # P('pp') aux out_spec sees the rank it expects
+                aux_all0 = (avals,)
+            else:
+                aux_all0 = avals
+
+            def tick(carry, t):
+                buf, outs, aux_all, key = carry
+                feed = xs[jnp.clip(t, 0, M - 1)]
+                out_idx = t - (S - 1)
+                lab_idx = jnp.clip(out_idx, 0, M - 1)
+                labels_mb = tuple(l[lab_idx] for l in ls)
+                tick_key = jax.random.fold_in(key, t)
+
+                if homogeneous:
+                    # identical graphs chain, so data microbatches share the
+                    # boundary shape and stage 0 can blend in via the ring
+                    a_in = jnp.where(s == 0, feed.astype(zero_ring.dtype),
+                                     buf)
+                    local_p = jax.tree_util.tree_map(lambda v: v[0], pvals)
+                    local_a = jax.tree_util.tree_map(lambda v: v[0],
+                                                     aux_all[0])
+                    outs_i, aux_upd = run_stage(0, a_in, labels_mb,
+                                                local_p, local_a, tick_key)
+                    ring = outs_i[0]
+                    heads = tuple(outs_i[:num_heads])
+                    new_aux_all = (jax.tree_util.tree_map(
+                        lambda v: v[None], aux_upd),)
+                else:
+                    # the data microbatch generally has a different shape
+                    # from the ring activation, so stage 0 reads `feed`
+                    # from its closure and ignores the ring buffer
+                    def branch(i):
+                        def f(buf, feed, labels_mb, aux_all):
+                            a_in = feed if i == 0 else buf
+                            if i == S - 1:
+                                # fill ticks feed the last stage garbage
+                                # whose OUTPUT is masked — but loss heads
+                                # inject their gradient unconditionally
+                                # (SoftmaxOutput ignores its cotangent by
+                                # reference contract), so the stage must
+                                # not execute at all on invalid ticks
+                                def taken(op):
+                                    a, lm, aux_i = op
+                                    outs_i, aux_upd = run_stage(
+                                        i, a, lm, pvals[i], aux_i, tick_key)
+                                    return tuple(outs_i), aux_upd
+
+                                def skipped(op):
+                                    _, _, aux_i = op
+                                    return tuple(
+                                        jnp.zeros(h.shape, h.dtype)
+                                        for h in head_avals
+                                    ), aux_i
+
+                                heads, aux_upd = jax.lax.cond(
+                                    out_idx >= 0, taken, skipped,
+                                    (a_in, labels_mb, aux_all[i]))
+                                ring = zero_ring
+                            else:
+                                outs_i, aux_upd = run_stage(
+                                    i, a_in, labels_mb, pvals[i],
+                                    aux_all[i], tick_key)
+                                ring = outs_i[0].astype(zero_ring.dtype)
+                                heads = tuple(
+                                    jnp.zeros(h.shape, h.dtype)
+                                    for h in head_avals
+                                )
+                            new_aux = tuple(
+                                aux_upd if j == i else aux_all[j]
+                                for j in range(S)
+                            )
+                            return ring, heads, new_aux
+                        return f
+
+                    ring, heads, new_aux_all = jax.lax.switch(
+                        s, [branch(i) for i in range(S)],
+                        buf, feed, labels_mb, aux_all,
+                    )
+
+                valid = (s == S - 1) & (out_idx >= 0)
+                new_outs = tuple(
+                    jnp.where(valid,
+                              ob.at[jnp.clip(out_idx, 0, M - 1)].set(h), ob)
+                    for ob, h in zip(outs, heads)
+                )
+                nxt = jax.lax.ppermute(ring, "pp",
+                                       [(i, (i + 1) % S) for i in range(S)])
+                return (nxt, new_outs, new_aux_all, key), None
+
+            (_, outs, aux_all, _), _ = jax.lax.scan(
+                tick, (zero_ring, outs0, aux_all0, rng),
+                jnp.arange(M + S - 1),
+            )
+            outs = tuple(jax.lax.psum(o, "pp") for o in outs)
+            if not homogeneous:
+                # in composed mode rank i holds the only true aux update
+                # for stage i — select it onto every rank so the P() out
+                # spec is honest (a bare P() out would silently take one
+                # rank's copy)
+                aux_all = tuple(
+                    jax.tree_util.tree_map(
+                        lambda v: jax.lax.psum(
+                            jnp.where(s == i, v, jnp.zeros_like(v)), "pp"),
+                        aux_all[i])
+                    for i in range(S)
+                )
+            return outs, aux_all
+
+        def sched_train(pvals, avals, rng, xs, ls):
+            """sched + loss + per-rank vjp with explicit psums: gradient
+            reduction across the mesh is spelled out here rather than left
+            to the transpose of replicated shard_map inputs (which is not
+            performed under check_vma=False)."""
+
+            def local_loss(pv):
+                outs, aux_all = sched(pv, avals, rng, xs, ls)
+                total = None
+                for j, o in enumerate(outs):
+                    if not jnp.issubdtype(o.dtype, jnp.floating):
+                        continue
+                    if loss_flags and loss_flags[j]:
+                        t = jnp.sum(o.astype(jnp.float32))
+                        total = t if total is None else total + t
+                if total is None:
+                    raise MXNetError(
+                        "pipelined training requires a loss head "
+                        "(SoftmaxOutput/MakeLoss/...) on the last stage"
+                    )
+                return total, (outs, aux_all)
+
+            grads, (outs, aux_all) = jax.grad(
+                local_loss, has_aux=True)(pvals)
+            # stacked params are pp-sharded: each rank's grad IS its slice,
+            # so only dp contributions sum; replicated (composed) params
+            # need the full cross-rank reduction
+            reduce_axes = (() if homogeneous else ("pp",)) \
+                + (("dp",) if dp else ())
+            if reduce_axes:
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, reduce_axes), grads)
+            return outs, aux_all, grads
+
+        def make_step():
+            def step(pvals, avals, rng, data, labels):
+                B = data.shape[0]
+                xs = data.reshape((M, B // M) + tuple(data.shape[1:]))
+                ls = tuple(l.reshape((M, B // M) + tuple(l.shape[1:]))
+                           for l in labels)
+                if homogeneous:
+                    pv_in = jax.tree_util.tree_map(
+                        lambda *leaves: jnp.stack(leaves), *pvals)
+                    av_in = jax.tree_util.tree_map(
+                        lambda *leaves: jnp.stack(leaves), *avals)
+                    p_spec = jax.tree_util.tree_map(lambda _: P("pp"),
+                                                    pv_in)
+                    a_spec = jax.tree_util.tree_map(lambda _: P("pp"),
+                                                    av_in)
+                    aux_out_spec = (jax.tree_util.tree_map(
+                        lambda _: P("pp"), avals[0]),)
+                else:
+                    pv_in, av_in = pvals, avals
+                    p_spec = jax.tree_util.tree_map(lambda _: P(), pv_in)
+                    a_spec = jax.tree_util.tree_map(lambda _: P(), av_in)
+                    aux_out_spec = a_spec
+                x_spec = P(None, dp)
+                out_specs = (tuple(P(None, dp) for _ in range(num_heads)),
+                             aux_out_spec)
+                if with_grads:
+                    # grads for stacked params stay sharded P('pp'); for
+                    # composed (replicated) params they are psum'ed inside
+                    out_specs = out_specs + (p_spec,)
+                mapped = jax.shard_map(
+                    sched_train if with_grads else sched, mesh=mesh,
+                    in_specs=(p_spec, a_spec, P(), x_spec,
+                              jax.tree_util.tree_map(lambda _: x_spec, ls)),
+                    out_specs=out_specs,
+                    check_vma=False,
+                )
+                res = mapped(pv_in, av_in, rng, xs, ls)
+                outs, aux_all = res[0], res[1]
+                outs_flat = tuple(
+                    o.reshape((o.shape[0] * o.shape[1],)
+                              + tuple(o.shape[2:]))
+                    for o in outs
+                )
+                if homogeneous:
+                    # aux comes back stacked over pp; unstack to per-stage
+                    aux_back = tuple(
+                        tuple(leaf[i] for leaf in aux_all[0])
+                        for i in range(S)
+                    )
+                else:
+                    aux_back = aux_all
+                next_rng = jax.random.fold_in(rng, 0x9E3779B9)
+                if not with_grads:
+                    return outs_flat, aux_back, next_rng
+                grads = res[2]
+                if homogeneous:
+                    grads = tuple(
+                        tuple(leaf[i] for leaf in grads)
+                        for i in range(S)
+                    )
+                return outs_flat, aux_back, grads, next_rng
+            return step
+
+        return make_step()
+
+    # -- Module-facing API ------------------------------------------------
+    def run(self, data_batch, is_train):
+        """Execute the pipeline; writes grads into the child executors'
+        grad arrays when training (so per-child ``update()`` just works)."""
+        import jax
+
+        from ..ndarray import NDArray, array as nd_array
+
+        pvals, avals = self._stage_vals()
+
+        def as_val(a):
+            return a._data if isinstance(a, NDArray) else nd_array(a)._data
+
+        data_v = as_val(data_batch.data[0])
+        labels = []
+        if self.infos[-1].label_names:
+            if getattr(data_batch, "label", None):
+                labels = [as_val(l) for l in data_batch.label]
+            elif is_train:
+                raise MXNetError("pipelined training batch carries no label")
+            else:
+                # label-less inference on a loss-headed pipeline: reuse the
+                # bound label arrays, as the serial executor group does
+                exe = self.infos[-1].exec_
+                labels = [exe.arg_dict[n]._data
+                          for n in self.infos[-1].label_names]
+        # the rng key stays device-resident across steps (each program
+        # returns its successor) — a fresh host-built key per execute
+        # would stall the dispatch pipeline on tunneled runtimes, the
+        # failure mode executor.py's _next_step exists to avoid
+        if self._rng_dev is None:
+            self._rng_dev = jax.random.PRNGKey(0)
+        with_grads = bool(is_train) and self.has_loss
+        if with_grads:
+            outs, aux_back, grads, self._rng_dev = \
+                self._program(is_train, True)(
+                    pvals, avals, self._rng_dev, data_v, tuple(labels))
+            self._write_grads(grads)
+        else:
+            outs, aux_back, self._rng_dev = self._program(is_train, False)(
+                pvals, avals, self._rng_dev, data_v, tuple(labels))
+        self._write_aux(aux_back)
+        for info in self.infos:
+            # the child's param/aux snapshots are stale once the engine
+            # writes into its executor arrays; get_params must re-sync
+            info.module._params_dirty = True
+        self._last_outputs = [NDArray(o) for o in outs]
+        return self._last_outputs
+
+    def _write_grads(self, grads):
+        for info, g in zip(self.infos, grads):
+            exe = info.exec_
+            for n, gv in zip(info.param_names, g):
+                arr = exe.grad_dict.get(n)
+                if arr is not None:
+                    arr._data = gv.astype(arr._data.dtype)
+
+    def _write_aux(self, aux_back):
+        for info, av in zip(self.infos, aux_back):
+            exe = info.exec_
+            for n, v in zip(info.aux_names, av):
+                exe.aux_dict[n]._data = v
+
+    @property
+    def outputs(self):
+        if self._last_outputs is None:
+            raise MXNetError("run a forward before get_outputs()")
+        return self._last_outputs

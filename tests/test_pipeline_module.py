@@ -1,0 +1,287 @@
+"""SequentialModule -> GPipe lowering under a 'pp' mesh axis.
+
+The oracle is serial equivalence: the pipelined module must produce the
+same outputs, gradients and post-update parameters as the identical layer
+stack trained as one plain Module (reference "usable from user code" bar:
+example/model-parallel-lstm — placement only; the schedule is TPU-native
+surface, parallel/pipeline_module.py).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import assert_almost_equal
+
+BATCH, DIM, HID, NCLS = 16, 8, 12, 5
+
+
+def _stage_syms():
+    """Four heterogeneous stages; the last carries the loss head."""
+    syms = []
+    for i in range(3):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=HID, name=f"st{i}_fc")
+        syms.append(mx.sym.Activation(fc, act_type="tanh", name=f"st{i}_act"))
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=NCLS, name="st3_fc")
+    syms.append(mx.sym.SoftmaxOutput(fc, name="softmax"))
+    return syms
+
+
+def _chain_sym():
+    """The same four stages composed as one symbol (serial oracle)."""
+    h = mx.sym.Variable("data")
+    for i in range(3):
+        h = mx.sym.FullyConnected(h, num_hidden=HID, name=f"st{i}_fc")
+        h = mx.sym.Activation(h, act_type="tanh", name=f"st{i}_act")
+    h = mx.sym.FullyConnected(h, num_hidden=NCLS, name="st3_fc")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _build_seq(mesh, microbatches=None):
+    syms = _stage_syms()
+    seq = mx.mod.SequentialModule(pipeline_microbatches=microbatches)
+    for i, s in enumerate(syms[:-1]):
+        seq.add(mx.mod.Module(s, data_names=("data",), label_names=None),
+                auto_wiring=i > 0)
+    seq.add(mx.mod.Module(syms[-1], data_names=("data",),
+                          label_names=("softmax_label",)),
+            take_labels=True, auto_wiring=True)
+    with parallel.with_mesh(mesh):
+        seq.bind(data_shapes=[("data", (BATCH, DIM))],
+                 label_shapes=[("softmax_label", (BATCH,))])
+    seq.init_params(initializer=mx.init.Uniform(0.5))
+    return seq
+
+
+def _batch(rs):
+    data = mx.nd.array(rs.randn(BATCH, DIM).astype(np.float32))
+    label = mx.nd.array(rs.randint(0, NCLS, (BATCH,)).astype(np.float32))
+    return mx.io.DataBatch(data=[data], label=[label])
+
+
+def test_sequential_module_lowers_to_pipeline():
+    mesh = parallel.make_mesh({"pp": 4})
+    seq = _build_seq(mesh)
+    assert seq._pp_engine is not None
+    assert seq._pp_engine.S == 4 and seq._pp_engine.M == 4
+    assert not seq._pp_engine.homogeneous  # loss head differs
+
+
+def test_pipelined_matches_serial_loss_grads_and_update():
+    rs = np.random.RandomState(7)
+    mesh = parallel.make_mesh({"pp": 4})
+    seq = _build_seq(mesh)
+
+    ref = mx.mod.Module(_chain_sym(), context=mx.cpu())
+    ref.bind(data_shapes=[("data", (BATCH, DIM))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    args, auxs = seq.get_params()
+    ref.init_params(arg_params={k: v.copy() for k, v in args.items()},
+                    aux_params={k: v.copy() for k, v in auxs.items()},
+                    initializer=None)
+
+    batch = _batch(rs)
+    seq.forward(batch, is_train=True)
+    seq.backward()
+    ref.forward(batch, is_train=True)
+    ref.backward()
+
+    out_pp = seq.get_outputs()[0].asnumpy()
+    out_ref = ref.get_outputs()[0].asnumpy()
+    assert_almost_equal(out_pp, out_ref, rtol=1e-5, atol=1e-6)
+
+    # per-parameter gradient equivalence (pipelined grads land in the
+    # child executors)
+    ref_grads = {n: g.asnumpy() for n, g in
+                 ref._exec_group._exec.grad_dict.items() if g is not None}
+    for info in seq._pp_engine.infos:
+        for n in info.param_names:
+            g = info.exec_.grad_dict[n].asnumpy()
+            assert_almost_equal(g, ref_grads[n], rtol=1e-4, atol=1e-6,
+                                names=(f"pp:{n}", f"serial:{n}"))
+
+    # one optimizer step then parameter equivalence
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    ref.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    seq.update()
+    ref.update()
+    a_pp, _ = seq.get_params()
+    a_ref, _ = ref.get_params()
+    for n in a_ref:
+        assert_almost_equal(a_pp[n].asnumpy(), a_ref[n].asnumpy(),
+                            rtol=1e-4, atol=1e-6, names=(n, n))
+
+
+def test_pipelined_fit_converges():
+    rs = np.random.RandomState(3)
+    mesh = parallel.make_mesh({"pp": 4})
+    seq = _build_seq(mesh)
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    # learnable synthetic task: labels from a fixed random projection
+    w = rs.randn(DIM, NCLS).astype(np.float32)
+    data = rs.randn(256, DIM).astype(np.float32)
+    label = np.argmax(data @ w, axis=1).astype(np.float32)
+    metric = mx.metric.Accuracy()
+    for epoch in range(12):
+        metric.reset()
+        for i in range(0, 256, BATCH):
+            b = mx.io.DataBatch(
+                data=[mx.nd.array(data[i:i + BATCH])],
+                label=[mx.nd.array(label[i:i + BATCH])])
+            seq.forward(b, is_train=True)
+            seq.backward()
+            seq.update()
+            seq.update_metric(metric, b.label)
+    assert metric.get()[1] > 0.8, metric.get()
+
+
+def test_homogeneous_stages_stack_and_match_serial():
+    rs = np.random.RandomState(1)
+    mesh = parallel.make_mesh({"pp": 4})
+    syms = []
+    for i in range(4):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=DIM, name=f"blk{i}_fc")
+        syms.append(mx.sym.Activation(fc, act_type="tanh",
+                                      name=f"blk{i}_act"))
+    seq = mx.mod.SequentialModule()
+    for i, s in enumerate(syms):
+        seq.add(mx.mod.Module(s, data_names=("data",), label_names=None),
+                auto_wiring=i > 0)
+    with parallel.with_mesh(mesh):
+        seq.bind(data_shapes=[("data", (BATCH, DIM))], for_training=False)
+    seq.init_params(initializer=mx.init.Uniform(0.5))
+    assert seq._pp_engine is not None and seq._pp_engine.homogeneous
+
+    h = mx.sym.Variable("data")
+    for i in range(4):
+        h = mx.sym.FullyConnected(h, num_hidden=DIM, name=f"blk{i}_fc")
+        h = mx.sym.Activation(h, act_type="tanh", name=f"blk{i}_act")
+    ref = mx.mod.Module(h, context=mx.cpu(), label_names=None)
+    ref.bind(data_shapes=[("data", (BATCH, DIM))], for_training=False)
+    args, auxs = seq.get_params()
+    ref.init_params(arg_params={k: v.copy() for k, v in args.items()},
+                    aux_params=None, initializer=None)
+
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.randn(BATCH, DIM).astype(np.float32))],
+        label=None)
+    seq.forward(batch, is_train=False)
+    ref.forward(batch, is_train=False)
+    assert_almost_equal(seq.get_outputs()[0].asnumpy(),
+                        ref.get_outputs()[0].asnumpy(),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_homogeneous_with_batchnorm_aux_updates():
+    # stacked-mode aux states (BN moving stats) must survive the P('pp')
+    # plumbing and update from the schedule's final microbatch
+    rs = np.random.RandomState(2)
+    mesh = parallel.make_mesh({"pp": 4})
+    syms = []
+    for i in range(4):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=DIM, name=f"bn{i}_fc")
+        b = mx.sym.BatchNorm(fc, name=f"bn{i}_bn", fix_gamma=False)
+        syms.append(mx.sym.Activation(b, act_type="tanh",
+                                      name=f"bn{i}_act"))
+    seq = mx.mod.SequentialModule()
+    for i, s in enumerate(syms):
+        seq.add(mx.mod.Module(s, data_names=("data",), label_names=None),
+                auto_wiring=i > 0)
+    with parallel.with_mesh(mesh):
+        seq.bind(data_shapes=[("data", (BATCH, DIM))])
+    seq.init_params(initializer=mx.init.Uniform(0.5))
+    assert seq._pp_engine.homogeneous
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.randn(BATCH, DIM).astype(np.float32))],
+        label=None)
+    seq.forward(batch, is_train=True)
+    _, auxs = seq.get_params()
+    moved = [n for n, v in auxs.items()
+             if "moving_mean" in n and np.abs(v.asnumpy()).max() > 1e-8]
+    assert len(moved) == 4, f"BN moving stats did not update: {moved}"
+
+
+def test_pipelined_label_less_inference():
+    rs = np.random.RandomState(5)
+    mesh = parallel.make_mesh({"pp": 4})
+    seq = _build_seq(mesh)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.randn(BATCH, DIM).astype(np.float32))],
+        label=None)
+    seq.forward(batch, is_train=False)  # predict/score path: no labels
+    out = seq.get_outputs()[0].asnumpy()
+    assert out.shape == (BATCH, NCLS)
+    assert_almost_equal(out.sum(axis=1), np.ones(BATCH), rtol=1e-4)
+
+
+def test_pipelined_rejects_grad_req_add():
+    mesh = parallel.make_mesh({"pp": 4})
+    syms = _stage_syms()
+    seq = mx.mod.SequentialModule()
+    for i, s in enumerate(syms[:-1]):
+        seq.add(mx.mod.Module(s, data_names=("data",), label_names=None),
+                auto_wiring=i > 0)
+    seq.add(mx.mod.Module(syms[-1], data_names=("data",),
+                          label_names=("softmax_label",)),
+            take_labels=True, auto_wiring=True)
+    with pytest.raises(MXNetError, match="add"):
+        with parallel.with_mesh(mesh):
+            seq.bind(data_shapes=[("data", (BATCH, DIM))],
+                     label_shapes=[("softmax_label", (BATCH,))],
+                     grad_req="add")
+
+
+def test_shape_differing_stages_use_composed_mode():
+    # structurally identical graphs whose bound widths differ cannot
+    # stack; they must quietly take the composed path, not crash
+    mesh = parallel.make_mesh({"pp": 4})
+    seq = mx.mod.SequentialModule()
+    for i in range(4):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=HID, name=f"w{i}_fc")
+        seq.add(mx.mod.Module(
+            mx.sym.Activation(fc, act_type="tanh", name=f"w{i}_act"),
+            data_names=("data",), label_names=None), auto_wiring=i > 0)
+    with parallel.with_mesh(mesh):
+        # stage 0 weight is (HID, DIM), later stages (HID, HID)
+        seq.bind(data_shapes=[("data", (BATCH, DIM))], for_training=False)
+    seq.init_params(initializer=mx.init.Uniform(0.5))
+    assert not seq._pp_engine.homogeneous
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(np.random.RandomState(0).randn(
+            BATCH, DIM).astype(np.float32))], label=None)
+    seq.forward(batch, is_train=False)
+    assert seq.get_outputs()[0].shape == (BATCH, HID)
+
+
+def test_pipeline_validation_errors():
+    mesh = parallel.make_mesh({"pp": 4})
+    syms = _stage_syms()
+    seq = mx.mod.SequentialModule()
+    for i, s in enumerate(syms[:2]):  # 2 stages on a pp=4 mesh
+        seq.add(mx.mod.Module(s, data_names=("data",), label_names=None),
+                auto_wiring=i > 0)
+    with pytest.raises(MXNetError, match="pp axis of size"):
+        with parallel.with_mesh(mesh):
+            seq.bind(data_shapes=[("data", (BATCH, DIM))])
+
+    seq2 = mx.mod.SequentialModule(pipeline_microbatches=5)
+    for i, s in enumerate(_stage_syms()[:-1]):
+        seq2.add(mx.mod.Module(s, data_names=("data",), label_names=None),
+                 auto_wiring=i > 0)
+    seq2.add(mx.mod.Module(_stage_syms()[-1], data_names=("data",),
+                           label_names=("softmax_label",)),
+             take_labels=True, auto_wiring=True)
+    with pytest.raises(MXNetError, match="not divisible"):
+        with parallel.with_mesh(mesh):
+            seq2.bind(data_shapes=[("data", (BATCH, DIM))],
+                      label_shapes=[("softmax_label", (BATCH,))])
