@@ -66,7 +66,16 @@ _declare("MXNET_PP_MICROBATCHES", int, 0,
          "Constructor arg pipeline_microbatches takes precedence.")
 _declare("MXNET_PS_PORT", int, 0,
          "Port for the dist_async parameter server (kvstore_async.py); "
-         "0 = coordinator port + 512. The DMLC_PS_ROOT_PORT analogue.")
+         "tools/launch.py allocates and exports it; 0 = coordinator port "
+         "+ 512 for hand-launched jobs. The DMLC_PS_ROOT_PORT analogue.")
+_declare("MXNET_PS_EXIT_TIMEOUT", float, 3600.0,
+         "Seconds rank 0's dist_async server waits at exit for every "
+         "worker's done marker before shutting down anyway (stragglers "
+         "are the point of async mode, so the default is generous; "
+         "launcher-supervised jobs can set it low for fast restarts).")
+_declare("MXNET_PS_MAX_FRAME", int, 1 << 31,
+         "Upper bound in bytes on a single dist_async wire frame payload "
+         "— a parse-time allocation guard on the typed tensor protocol.")
 _declare("MXNET_XLA_TPU_OPTIONS", str, "",
          "Comma-separated key=value XLA compiler options attached to every "
          "executor program when the target is a TPU (ignored on CPU). The "

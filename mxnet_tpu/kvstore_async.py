@@ -10,25 +10,35 @@ There is no idiomatic on-chip analogue (an SPMD program cannot hogwild),
 so this is faithfully a HOST-side subsystem: rank 0's process hosts the
 server thread (the tracker-launched-server analogue for the TPU world,
 where every host already runs a worker), and workers talk to it over TCP
-with length-prefixed pickles. Pushes take the server lock, apply the
-updater (or sum-accumulate when none is installed) and return; pulls read
-the current weights. No barriers anywhere in the data path — stale
-gradients are the documented semantics, exactly like the reference.
+with a TYPED binary frame protocol — fixed header, dtype/shape metadata,
+raw tensor bytes (the ps-lite analogue: nothing on the wire can execute
+code; the optimizer never crosses the wire, it is installed rank-0
+locally). When the launcher exports ``MXNET_PS_KEY`` every frame is
+HMAC-SHA256 signed and the server rejects unsigned or mis-signed frames,
+so a stray process that can reach the port cannot inject state; without
+a key the trust assumption is the cluster fabric (documented). Pushes
+take the server lock, apply the updater (or replace when none is
+installed) and return; pulls read the current weights. No barriers
+anywhere in the data path — stale gradients are the documented
+semantics, exactly like the reference.
 
-Rendezvous: the server binds on the MXNET_COORDINATOR host (exported by
-tools/launch.py) at the coordinator port + 512; MXNET_PS_PORT overrides
-the port if that one is taken (set it yourself — launch.py does not).
+Rendezvous: the server binds on the MXNET_COORDINATOR host at the port
+``tools/launch.py`` allocates and exports as MXNET_PS_PORT (fallback:
+coordinator port + 512 when launched by hand).
 
 Lifecycle: every client sends a ``done`` marker at interpreter exit, and
 rank 0's exit hook keeps the server alive until all workers have reported
-done (or a generous timeout), so naturally-finishing async jobs need no
-explicit barriers even though rank 0 usually finishes its shard first.
+done (or MXNET_PS_EXIT_TIMEOUT), so naturally-finishing async jobs need
+no explicit barriers even though rank 0 usually finishes its shard
+first. A worker whose connection breaks after it pushed counts as
+implicitly done — a crashed straggler must not stall the server's exit.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac as hmac_mod
 import os
-import pickle
 import socket
 import struct
 import threading
@@ -39,27 +49,140 @@ import numpy as np
 from .base import MXNetError
 from .kvstore import KVStore, _updater_key
 
+# --- wire protocol ---------------------------------------------------------
+# frame: header | dims | key-utf8 | payload | [mac]
+#   header: magic(4) ver(1) op(1) flags(1) dtype(1) ndim(1) klen(2) plen(8)
+#   flags: bit0 = expect_updater (push), bit1 = frame is HMAC-signed
+# Tensors travel as raw C-order bytes + (dtype code, dims). Parsing can
+# allocate at most MXNET_PS_MAX_FRAME bytes and interpret nothing as code.
+_MAGIC = b"MXPS"
+_WIRE_VERSION = 1
+_HDR = struct.Struct("<4sBBBBBHQ")
+_MAC_LEN = 32
+_MAX_NDIM = 16
 
-def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+_OP_INIT, _OP_PUSH, _OP_PULL, _OP_BARRIER, _OP_DONE, _OP_STOP = range(1, 7)
+_OP_OK, _OP_ERR, _OP_VAL = 16, 17, 18
+
+_DTYPE_CODES = {
+    np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2, np.dtype(np.int32): 3,
+    np.dtype(np.int64): 4, np.dtype(np.uint8): 5,
+}
+_CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
 
 
-def _recv_msg(sock):
-    hdr = b""
-    while len(hdr) < 8:
-        chunk = sock.recv(8 - len(hdr))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        hdr += chunk
-    (n,) = struct.unpack("<Q", hdr)
+class _WireError(MXNetError):
+    """A malformed or unauthenticated frame — always fatal for the
+    connection that sent it (fail loudly, never guess)."""
+
+
+def _wire_key():
+    raw = os.environ.get("MXNET_PS_KEY", "")
+    return bytes.fromhex(raw) if raw else None
+
+
+def _max_frame():
+    from . import env
+
+    return env.get("MXNET_PS_MAX_FRAME")
+
+
+def _pack_frame(op, key="", arr=None, flags=0, secret=None):
+    if arr is not None:
+        arr = np.ascontiguousarray(arr)
+        code = _DTYPE_CODES.get(arr.dtype)
+        if code is None:
+            raise MXNetError(
+                f"dist_async cannot ship dtype {arr.dtype}; supported: "
+                f"{sorted(str(d) for d in _DTYPE_CODES)}"
+            )
+        dims, payload = arr.shape, arr.tobytes()
+    else:
+        code, dims, payload = 0, (), b""
+    kb = key.encode("utf-8")
+    if secret is not None:
+        flags |= 2
+    body = _HDR.pack(_MAGIC, _WIRE_VERSION, op, flags, code, len(dims),
+                     len(kb), len(payload))
+    body += struct.pack(f"<{len(dims)}q", *dims) + kb + payload
+    if secret is not None:
+        body += hmac_mod.new(secret, body, hashlib.sha256).digest()
+    return body
+
+
+def _read_exact(sock, n):
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             raise ConnectionError("peer closed")
         buf += chunk
-    return pickle.loads(bytes(buf))
+    return bytes(buf)
+
+
+def _recv_frame(sock, secret=None):
+    """Parse one frame. Returns (op, flags, key, arr-or-None).
+
+    Raises _WireError on anything malformed or unauthenticated; the
+    caller must treat that as a poisoned connection, not a request.
+    """
+    hdr = _read_exact(sock, _HDR.size)
+    magic, ver, op, flags, code, ndim, klen, plen = _HDR.unpack(hdr)
+    if magic != _MAGIC:
+        raise _WireError(f"bad frame magic {magic!r}")
+    if ver != _WIRE_VERSION:
+        raise _WireError(f"wire version {ver} != {_WIRE_VERSION}")
+    if ndim > _MAX_NDIM:
+        raise _WireError(f"ndim {ndim} exceeds {_MAX_NDIM}")
+    if plen > _max_frame():
+        raise _WireError(
+            f"frame payload {plen} exceeds MXNET_PS_MAX_FRAME "
+            f"({_max_frame()})"
+        )
+    rest = _read_exact(sock, 8 * ndim + klen + plen)
+    if secret is not None:
+        if not flags & 2:
+            raise _WireError("unsigned frame on a keyed server")
+        mac = _read_exact(sock, _MAC_LEN)
+        want = hmac_mod.new(secret, hdr + rest, hashlib.sha256).digest()
+        if not hmac_mod.compare_digest(mac, want):
+            raise _WireError("frame HMAC mismatch")
+    elif flags & 2:
+        _read_exact(sock, _MAC_LEN)  # drain the unverifiable mac
+    dims = struct.unpack(f"<{ndim}q", rest[:8 * ndim])
+    if any(d < 0 for d in dims):
+        raise _WireError(f"negative dim in {dims}")
+    try:
+        key = rest[8 * ndim:8 * ndim + klen].decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise _WireError(f"key is not valid utf-8: {e}") from None
+    payload = rest[8 * ndim + klen:]
+    arr = None
+    if plen or ndim:
+        if not ndim:
+            raise _WireError("tensor payload without dims")
+        dtype = _CODE_DTYPES.get(code)
+        if dtype is None:
+            raise _WireError(f"unknown dtype code {code}")
+        want_bytes = int(np.prod(dims, dtype=np.int64)) * dtype.itemsize
+        if want_bytes != plen:
+            raise _WireError(
+                f"payload {plen} bytes != shape {dims} x {dtype} "
+                f"({want_bytes})"
+            )
+        arr = np.frombuffer(payload, dtype=dtype).reshape(dims).copy()
+    return op, flags, key, arr
+
+
+def _send_ok(sock, secret):
+    sock.sendall(_pack_frame(_OP_OK, secret=secret))
+
+
+def _send_err(sock, msg, secret):
+    sock.sendall(_pack_frame(
+        _OP_ERR, arr=np.frombuffer(msg.encode("utf-8"), dtype=np.uint8),
+        secret=secret))
 
 
 class _PSServer:
@@ -68,6 +191,7 @@ class _PSServer:
     def __init__(self, host, port, num_workers):
         self._store = {}
         self._updater = None
+        self._secret = _wire_key()
         self._lock = threading.Lock()
         self._updater_cv = threading.Condition(self._lock)
         self._num_workers = num_workers
@@ -78,6 +202,11 @@ class _PSServer:
         self._barrier_cv = threading.Condition(self._lock)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if hasattr(socket, "SO_REUSEPORT"):
+            # tools/launch.py reserves the allocated port by keeping its
+            # own SO_REUSEPORT socket bound (never listening); the server
+            # must opt in too to bind alongside it
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         self._sock.bind((host, port))
         self._sock.listen(num_workers * 2)
         self._stop = False
@@ -89,11 +218,16 @@ class _PSServer:
             self._updater = updater
             self._updater_cv.notify_all()
 
-    def wait_all_done(self, timeout=3600.0):
-        """Wait for every worker's done marker. The generous default exists
+    def wait_all_done(self, timeout=None):
+        """Wait for every worker's done marker (explicit, or implicit via a
+        connection that broke after pushing). The generous default exists
         for straggler tolerance — the whole point of async mode; a timeout
         is logged loudly because tearing the server down strands any
         worker still training."""
+        if timeout is None:
+            from . import env
+
+            timeout = float(env.get("MXNET_PS_EXIT_TIMEOUT"))
         deadline = time.time() + timeout
         with self._done_cv:
             while self._done_count < self._num_workers:
@@ -122,22 +256,44 @@ class _PSServer:
             ).start()
 
     def _serve(self, conn):
+        secret = self._secret
+        touched = False  # any authenticated request seen on this conn
+        explicit_done = False
         try:
             while True:
-                msg = _recv_msg(conn)
-                op = msg[0]
-                if op == "init":
-                    _, key, arr = msg
+                try:
+                    op, flags, key, arr = _recv_frame(conn, secret)
+                except _WireError as e:
+                    # malformed or unauthenticated frame: refuse loudly and
+                    # poison the connection — never act on a bad frame
+                    import logging
+
+                    logging.error("dist_async server: rejecting frame: %s",
+                                  e)
+                    try:
+                        _send_err(conn, f"rejected frame: {e}", secret)
+                    except OSError:
+                        pass
+                    return
+                if op in (_OP_INIT, _OP_PUSH):
+                    if arr is None:
+                        _send_err(conn, f"op {op} requires a tensor payload",
+                                  secret)
+                        continue
+                    # init/push identify a WORKER connection (a pull-only
+                    # monitor must not count toward the done tally)
+                    touched = True
+                if op == _OP_INIT:
                     with self._lock:
                         # first init wins (reference CHECK on re-init is
                         # relaxed: every worker inits the same values)
                         self._store.setdefault(key, arr.copy())
-                    _send_msg(conn, ("ok",))
-                elif op == "push":
-                    _, key, grad, expect_updater = msg
+                    _send_ok(conn, secret)
+                elif op == _OP_PUSH:
+                    expect_updater = bool(flags & 1)
                     with self._updater_cv:
                         if key not in self._store:
-                            _send_msg(conn, ("err", f"init {key} first"))
+                            _send_err(conn, f"init {key} first", secret)
                             continue
                         # a TRAINING push (client has an optimizer) may race
                         # ahead of rank 0 installing the server updater;
@@ -150,32 +306,31 @@ class _PSServer:
                                     break
                                 self._updater_cv.wait(left)
                         if expect_updater and self._updater is None:
-                            _send_msg(conn, (
-                                "err",
+                            _send_err(conn, (
                                 "no server optimizer installed (rank 0 "
-                                "never called set_optimizer)"))
+                                "never called set_optimizer)"), secret)
                             continue
                         if self._updater is not None:
                             # hogwild: apply THIS worker's gradient now
                             from .ndarray import array
 
                             w = array(self._store[key])
-                            self._updater(_updater_key(key), array(grad), w)
+                            self._updater(_updater_key(key), array(arr), w)
                             self._store[key] = w.asnumpy()
                         else:
                             # no optimizer anywhere: plain store semantics —
                             # push REPLACES, like every other KVStore here
-                            self._store[key] = grad.copy()
-                    _send_msg(conn, ("ok",))
-                elif op == "pull":
-                    _, key = msg
+                            self._store[key] = arr.copy()
+                    _send_ok(conn, secret)
+                elif op == _OP_PULL:
                     with self._lock:
-                        arr = self._store.get(key)
-                    if arr is None:
-                        _send_msg(conn, ("err", f"init {key} first"))
+                        val = self._store.get(key)
+                    if val is None:
+                        _send_err(conn, f"init {key} first", secret)
                     else:
-                        _send_msg(conn, ("val", arr))
-                elif op == "barrier":
+                        conn.sendall(_pack_frame(_OP_VAL, arr=val,
+                                                 secret=secret))
+                elif op == _OP_BARRIER:
                     with self._barrier_cv:
                         gen = self._barrier_gen
                         self._barrier_count += 1
@@ -186,20 +341,42 @@ class _PSServer:
                         else:
                             while gen == self._barrier_gen:
                                 self._barrier_cv.wait()
-                    _send_msg(conn, ("ok",))
-                elif op == "done":
+                    _send_ok(conn, secret)
+                elif op == _OP_DONE:
+                    explicit_done = True
                     with self._done_cv:
                         self._done_count += 1
                         self._done_cv.notify_all()
-                    _send_msg(conn, ("ok",))
-                elif op == "stop":
-                    _send_msg(conn, ("ok",))
+                    _send_ok(conn, secret)
+                elif op == _OP_STOP:
+                    _send_ok(conn, secret)
                     return
                 else:
-                    _send_msg(conn, ("err", f"unknown op {op!r}"))
+                    _send_err(conn, f"unknown op {op}", secret)
         except (ConnectionError, EOFError, OSError):
             pass
+        except Exception:  # a handler bug must still answer + not hang exit
+            import logging
+
+            logging.exception("dist_async server: handler error")
+            try:
+                _send_err(conn, "internal server error", secret)
+            except OSError:
+                pass
         finally:
+            if touched and not explicit_done:
+                # a worker that spoke the protocol (init or push) and then
+                # lost its connection — crash, OOM, kill — must not stall
+                # wait_all_done for the full exit timeout
+                import logging
+
+                logging.warning(
+                    "dist_async server: worker connection broke before its "
+                    "done marker; counting it as done"
+                )
+                with self._done_cv:
+                    self._done_count += 1
+                    self._done_cv.notify_all()
             conn.close()
 
     def shutdown(self):
@@ -256,21 +433,27 @@ class AsyncDistKVStore(KVStore):
                 raise MXNetError(f"dist_async: cannot reach server: {last}")
         return self._sock
 
-    def _rpc(self, *msg):
+    def _rpc(self, op, key="", arr=None, flags=0):
+        secret = _wire_key()
         try:
             with self._sock_lock:
                 sock = self._conn()
-                _send_msg(sock, msg)
-                resp = _recv_msg(sock)
+                sock.sendall(_pack_frame(op, key, arr, flags, secret))
+                rop, _, _, rarr = _recv_frame(sock, secret)
         except (ConnectionError, OSError) as e:
             raise MXNetError(
                 f"dist_async: lost the parameter server at {self._addr} "
                 f"({e}); rank 0 may have exited or timed out waiting for "
                 "stragglers"
             ) from e
-        if resp[0] == "err":
-            raise MXNetError(f"dist_async server: {resp[1]}")
-        return resp[1] if len(resp) > 1 else None
+        if rop == _OP_ERR:
+            msg = rarr.tobytes().decode("utf-8") if rarr is not None else ""
+            raise MXNetError(f"dist_async server: {msg}")
+        if rop == _OP_VAL:
+            return rarr
+        if rop != _OP_OK:
+            raise MXNetError(f"dist_async: unexpected response op {rop}")
+        return None
 
     # --- KVStore interface ----------------------------------------------
     @property
@@ -288,7 +471,7 @@ class AsyncDistKVStore(KVStore):
         keys, vals = _key_value(key, value)
         for k, v in zip(keys, vals):
             arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
-            self._rpc("init", k, arr)
+            self._rpc(_OP_INIT, k, arr)
 
     def push(self, key, value, priority=0):
         from .kvstore import _key_value, _merge_pushed
@@ -296,8 +479,8 @@ class AsyncDistKVStore(KVStore):
         keys, vals = _key_value(key, value)
         for k, v in zip(keys, vals):
             merged = _merge_pushed(v)
-            self._rpc("push", k, np.asarray(merged.asnumpy()),
-                      self._has_optimizer)
+            self._rpc(_OP_PUSH, k, np.asarray(merged.asnumpy()),
+                      flags=int(self._has_optimizer))
 
     def pull(self, key, out=None, priority=0):
         from .kvstore import _key_value
@@ -306,7 +489,7 @@ class AsyncDistKVStore(KVStore):
         assert out is not None
         keys, outs = _key_value(key, out)
         for k, o in zip(keys, outs):
-            arr = self._rpc("pull", k)
+            arr = self._rpc(_OP_PULL, k)
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 if isinstance(t, NDArray):
@@ -346,7 +529,7 @@ class AsyncDistKVStore(KVStore):
         )
 
     def barrier(self):
-        self._rpc("barrier")
+        self._rpc(_OP_BARRIER)
 
     @property
     def type(self):
@@ -359,7 +542,7 @@ class AsyncDistKVStore(KVStore):
         if not self._done_sent:
             self._done_sent = True
             try:
-                self._rpc("done")
+                self._rpc(_OP_DONE)
             except (MXNetError, OSError):
                 pass
         if self._server is not None:

@@ -11,6 +11,8 @@ import socket
 import subprocess
 import sys
 
+import numpy as np
+
 import pytest
 
 _ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
@@ -137,6 +139,70 @@ def test_mid_training_worker_kill_recovers_and_converges():
     for r in range(2):
         assert f"rank {r}/2 FAULT-RECOVERY OK" in out, out[-4000:]
     assert "dead=1" in out, out[-4000:]
+
+
+def test_async_wire_format_roundtrip():
+    """The dist_async wire protocol is typed frames (header + dtype/shape
+    + raw bytes), not pickle — nothing on the wire can execute code."""
+    from mxnet_tpu import kvstore_async as ka
+
+    a, b = socket.socketpair()
+    try:
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        key = b"k" * 32
+        a.sendall(ka._pack_frame(ka._OP_PUSH, "w0", arr, flags=1,
+                                 secret=key))
+        op, flags, k, got = ka._recv_frame(b, secret=key)
+        assert op == ka._OP_PUSH and k == "w0" and flags & 1
+        np.testing.assert_array_equal(got, arr)
+        assert "import pickle" not in open(ka.__file__).read()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_async_server_rejects_garbage_and_bad_hmac():
+    """A garbage frame or a frame signed with the wrong key must fail
+    loudly (connection poisoned, state untouched) instead of executing —
+    the ADVICE.md pickle-RCE surface is gone."""
+    from mxnet_tpu import kvstore_async as ka
+    from mxnet_tpu.base import MXNetError
+
+    os.environ["MXNET_PS_KEY"] = "ab" * 32
+    try:
+        port = _free_port()
+        server = ka._PSServer("127.0.0.1", port, num_workers=1)
+        try:
+            # raw garbage bytes: server must refuse and close, not act
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            s.sendall(b"GET / HTTP/1.1\r\n\r\n" + b"\x00" * 64)
+            s.settimeout(10)
+            try:
+                while s.recv(4096):  # drain err frame until clean close
+                    pass
+            except OSError:
+                pass
+            s.close()
+            assert server._store == {}
+
+            # correctly-formed frame, wrong key: rejected by HMAC
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            bad = ka._pack_frame(ka._OP_INIT, "w0",
+                                 np.zeros(4, np.float32),
+                                 secret=b"wrong-key-wrong-key-wrong-key-00")
+            s.sendall(bad)
+            try:
+                op, _, _, arr = ka._recv_frame(s, secret=bytes.fromhex(
+                    os.environ["MXNET_PS_KEY"]))
+                assert op == ka._OP_ERR
+            except (ConnectionError, MXNetError):
+                pass  # poisoned connection is an acceptable loud failure
+            s.close()
+            assert server._store == {}, "bad frame mutated server state"
+        finally:
+            server.shutdown()
+    finally:
+        del os.environ["MXNET_PS_KEY"]
 
 
 def test_dist_async_parameter_server_trains():

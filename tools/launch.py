@@ -22,7 +22,44 @@ import subprocess
 import sys
 
 
-def _worker_env(rank, num_workers, coordinator, num_restarts=0):
+def _alloc_ps_port(coordinator):
+    """Pick the dist_async parameter-server port for this job.
+
+    When the coordinator host is local the port is allocated by binding
+    with SO_REUSEPORT and HOLDING the socket for the launcher's lifetime,
+    so the ephemeral port cannot be handed to another process before (or
+    while) rank 0's server binds it with its own SO_REUSEPORT socket (the
+    launcher's bound-but-not-listening socket never receives connections).
+    For remote coordinators fall back to the deterministic
+    coordinator-port+512 convention. Either way the chosen port is
+    exported as MXNET_PS_PORT so workers and server agree by construction.
+
+    Returns (port, holder_socket_or_None); the caller keeps the holder
+    referenced for the job's duration."""
+    import socket
+
+    host, port = coordinator.rsplit(":", 1)
+    if host in ("127.0.0.1", "localhost", "0.0.0.0") and \
+            hasattr(socket, "SO_REUSEPORT"):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1], s
+    return int(port) + 512, None
+
+
+def _job_security_env():
+    """A per-job random HMAC key for the dist_async wire protocol, unless
+    the operator already provided one."""
+    if os.environ.get("MXNET_PS_KEY"):
+        return {}
+    import secrets
+
+    return {"MXNET_PS_KEY": secrets.token_hex(32)}
+
+
+def _worker_env(rank, num_workers, coordinator, num_restarts=0,
+                job_env=None):
     env = dict(os.environ)
     env.update({
         "MXNET_COORDINATOR": coordinator,
@@ -35,6 +72,7 @@ def _worker_env(rank, num_workers, coordinator, num_restarts=0):
         "DMLC_NUM_WORKER": str(num_workers),
         "DMLC_WORKER_ID": str(rank),
     })
+    env.update(job_env or {})
     return env
 
 
@@ -58,12 +96,17 @@ def _supervise_local(command, num_workers, coordinator, max_restarts):
 
     host, port0 = coordinator.rsplit(":", 1)
     attempt = 0
+    job_env = _job_security_env()
+    holders = []  # keep allocated PS ports reserved for the job's lifetime
     while True:
         coord = f"{host}:{int(port0) + attempt}"
+        ps_port, holder = _alloc_ps_port(coord)
+        holders.append(holder)
+        job_env["MXNET_PS_PORT"] = str(ps_port)
         procs = {
             rank: subprocess.Popen(
                 command,
-                env=_worker_env(rank, num_workers, coord, attempt),
+                env=_worker_env(rank, num_workers, coord, attempt, job_env),
             )
             for rank in range(num_workers)
         }
@@ -131,16 +174,30 @@ def main():
             args.command, args.num_workers, coordinator, args.max_restarts
         ))
 
+    job_env = _job_security_env()
+    ps_port, _ps_holder = _alloc_ps_port(coordinator)
+    job_env["MXNET_PS_PORT"] = str(ps_port)
     procs = []
     for rank in range(args.num_workers):
-        env = _worker_env(rank, args.num_workers, coordinator)
+        env = _worker_env(rank, args.num_workers, coordinator,
+                          job_env=job_env)
         remote_env = " ".join(
             f"{k}={v}" for k, v in env.items()
-            if k.startswith(("MXNET_", "DMLC_"))
+            if k.startswith(("MXNET_", "DMLC_")) and k != "MXNET_PS_KEY"
         )
+        # the HMAC secret must never ride the command line (argv is world-
+        # readable via ps on both ends); feed it through ssh stdin instead
+        key = env.get("MXNET_PS_KEY", "")
+        key_prefix = "IFS= read -r MXNET_PS_KEY; export MXNET_PS_KEY; " \
+            if key else ""
         cmd = ["ssh", hosts[rank],
-               f"cd {os.getcwd()} && {remote_env} {' '.join(args.command)}"]
-        procs.append(subprocess.Popen(cmd))
+               f"{key_prefix}cd {os.getcwd()} && {remote_env} "
+               f"{' '.join(args.command)}"]
+        p = subprocess.Popen(cmd, stdin=subprocess.PIPE if key else None)
+        if key:
+            p.stdin.write((key + "\n").encode())
+            p.stdin.close()
+        procs.append(p)
 
     code = 0
     for p in procs:
