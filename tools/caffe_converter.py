@@ -1,22 +1,25 @@
 #!/usr/bin/env python
-"""Caffe prototxt -> Symbol converter.
+"""Caffe prototxt (+ .caffemodel weights) -> Symbol + params converter.
 
-Reference: ``tools/caffe_converter/convert_symbol.py`` (parses a Caffe
-network definition and emits the equivalent mx.symbol graph; its sibling
-``convert_model.py`` additionally converts ``.caffemodel`` weights, which
-requires the Caffe protobuf runtime and is out of scope here — weights
-import via the standard ``.params`` path instead).
+Reference: ``tools/caffe_converter/convert_symbol.py`` and
+``convert_model.py`` (the reference needs the Caffe protobuf runtime;
+here BOTH wire formats are parsed directly — the prototxt text-protobuf
+with a hand-rolled tokenizer, and the ``.caffemodel`` binary protobuf
+with a minimal varint/wire-type walker — so pretrained Caffe models
+migrate with no Caffe or protoc dependency).
 
-The prototxt text-protobuf format is parsed directly (no protobuf
-dependency): both the modern ``layer {}`` and legacy ``layers {}`` blocks,
-string and enum layer types. Supported layers: Convolution, InnerProduct,
-Pooling (MAX/AVE, global), ReLU, LRN, Dropout, Concat, Eltwise (SUM),
-BatchNorm (+ following Scale folded in), Flatten, Softmax /
-SoftmaxWithLoss, Accuracy (skipped), Data/Input (becomes the data
-Variable). In-place layers (same top as bottom) chain naturally.
+Supported layers: Convolution, Deconvolution, InnerProduct, Pooling
+(MAX/AVE, global), ReLU, Sigmoid, TanH, LRN, Dropout, Concat, Eltwise,
+BatchNorm (+ following Scale folded into gamma/beta, statistics
+de-scaled by the blob scale factor), Flatten, Crop, Slice, Power,
+Softmax / SoftmaxWithLoss, Accuracy (skipped), Data/Input (becomes the
+data Variable). Both the modern ``layer {}`` and legacy ``layers {}``
+blocks parse; in-place layers (same top as bottom) chain naturally.
 
 Usage:
     python tools/caffe_converter.py net.prototxt [-o out-symbol.json]
+    python tools/caffe_converter.py net.prototxt -w net.caffemodel \\
+        -o converted          # writes converted-symbol.json + -0000.params
 """
 
 from __future__ import annotations
@@ -109,21 +112,41 @@ def _as_list(v):
 # ---------------------------------------------------------------------------
 # layer mapping
 # ---------------------------------------------------------------------------
+def _hw(p, base, default=None):
+    """Resolve a possibly-repeated spatial field per Caffe semantics:
+    one value applies to both axes, two values are (h, w); explicit
+    ``<base>_h`` / ``<base>_w`` win."""
+    v = _as_list(p.get(base, default))
+    if not v:
+        v = [default]
+    h = p.get(base + "_h", v[0])
+    w = p.get(base + "_w", v[1] if len(v) > 1 else v[0])
+    if h is None or w is None:
+        raise ValueError(f"caffe_converter: missing required field "
+                         f"{base!r} in {p}")
+    return (int(h), int(w))
+
+
 def _kernel(p):
-    k = p.get("kernel_size", p.get("kernel_h"))
-    kh = p.get("kernel_h", k)
-    kw = p.get("kernel_w", k)
-    return (int(kh), int(kw))
+    return _hw(p, "kernel_size")
 
 
 def _stride(p):
-    s = p.get("stride", 1)
-    return (int(p.get("stride_h", s)), int(p.get("stride_w", s)))
+    return _hw(p, "stride", 1)
 
 
 def _pad(p):
-    d = p.get("pad", 0)
-    return (int(p.get("pad_h", d)), int(p.get("pad_w", d)))
+    return _hw(p, "pad", 0)
+
+
+def _required(p, field, layer_name):
+    v = p.get(field)
+    if v is None:
+        raise ValueError(
+            f"caffe_converter: layer {layer_name!r} is missing required "
+            f"field {field!r}"
+        )
+    return _as_list(v)[0]
 
 
 def convert_symbol(prototxt_text):
@@ -166,15 +189,23 @@ def convert_symbol(prototxt_text):
         elif ltype == "CONVOLUTION":
             p = layer.get("convolution_param", {})
             out = mx.sym.Convolution(
-                b0, num_filter=int(p["num_output"]), kernel=_kernel(p),
-                stride=_stride(p), pad=_pad(p),
+                b0, num_filter=int(_required(p, "num_output", name)),
+                kernel=_kernel(p), stride=_stride(p), pad=_pad(p),
+                num_group=int(p.get("group", 1)),
+                no_bias=not bool(p.get("bias_term", 1)), name=name,
+            )
+        elif ltype == "DECONVOLUTION":
+            p = layer.get("convolution_param", {})
+            out = mx.sym.Deconvolution(
+                b0, num_filter=int(_required(p, "num_output", name)),
+                kernel=_kernel(p), stride=_stride(p), pad=_pad(p),
                 num_group=int(p.get("group", 1)),
                 no_bias=not bool(p.get("bias_term", 1)), name=name,
             )
         elif ltype in ("INNERPRODUCT", "INNER_PRODUCT"):
             p = layer.get("inner_product_param", {})
             out = mx.sym.FullyConnected(
-                b0, num_hidden=int(p["num_output"]),
+                b0, num_hidden=int(_required(p, "num_output", name)),
                 no_bias=not bool(p.get("bias_term", 1)), name=name,
             )
         elif ltype == "POOLING":
@@ -266,6 +297,57 @@ def convert_symbol(prototxt_text):
             out = b0
         elif ltype == "FLATTEN":
             out = mx.sym.Flatten(b0, name=name)
+        elif ltype == "CROP":
+            p = layer.get("crop_param", {})
+            axis = int(p.get("axis", 2))
+            if axis != 2 or len(bottoms) != 2:
+                raise ValueError(
+                    f"caffe_converter: Crop layer {name!r} supports only "
+                    "axis=2 with a reference bottom (spatial crop-like)"
+                )
+            offs = [int(o) for o in _as_list(p.get("offset", 0))]
+            if len(offs) == 1:
+                offs = offs * 2
+            out = mx.sym.Crop(b0, bottoms[1], offset=tuple(offs), name=name)
+        elif ltype == "SLICE":
+            p = layer.get("slice_param", {})
+            axis = int(p.get("axis", p.get("slice_dim", 1)))
+            points = [int(x) for x in _as_list(p.get("slice_point"))]
+            ntop = len(top) if top else 2
+            if points:
+                # arbitrary split points -> slice_axis per segment
+                bounds = [0] + points + [None]
+                outs_list = [
+                    mx.sym.slice_axis(b0, axis=axis, begin=bounds[i],
+                                      end=bounds[i + 1],
+                                      name=f"{name}_out{i}")
+                    for i in range(len(bounds) - 1)
+                ]
+            else:
+                sliced = mx.sym.SliceChannel(
+                    b0, num_outputs=ntop, axis=axis, name=name)
+                outs_list = [sliced[i] for i in range(ntop)]
+            if len(outs_list) != len(top or []):
+                raise ValueError(
+                    f"caffe_converter: Slice layer {name!r} produces "
+                    f"{len(outs_list)} outputs for {len(top or [])} tops"
+                )
+            for t, o in zip(top, outs_list):
+                tops[t] = o
+            last = outs_list[-1]
+            continue
+        elif ltype == "POWER":
+            p = layer.get("power_param", {})
+            power = float(p.get("power", 1.0))
+            scale = float(p.get("scale", 1.0))
+            shift = float(p.get("shift", 0.0))
+            out = b0
+            if scale != 1.0:
+                out = out * scale
+            if shift != 0.0:
+                out = out + shift
+            if power != 1.0:
+                out = out ** power
         elif ltype in ("SOFTMAX", "SOFTMAXWITHLOSS", "SOFTMAX_LOSS"):
             if len(bottoms) > 1:
                 out = mx.sym.SoftmaxOutput(b0, bottoms[1], name=name)
@@ -286,18 +368,218 @@ def convert_symbol(prototxt_text):
     return last, input_name
 
 
+# ---------------------------------------------------------------------------
+# .caffemodel binary protobuf reader (no protoc / caffe dependency)
+# ---------------------------------------------------------------------------
+def _uvarint(buf, pos):
+    """Decode one unsigned varint; returns (value, new_pos). Raises on a
+    truncated buffer instead of reading garbage."""
+    v = 0
+    shift = 0
+    n = len(buf)
+    while True:
+        if pos >= n:
+            raise ValueError("caffe_converter: truncated protobuf (varint "
+                             "runs past end of buffer)")
+        b = buf[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            return v, pos
+
+
+def _pb_walk(buf):
+    """Yield (field_number, wire_type, value) over one protobuf message.
+
+    value is an int for varint(0)/fixed(1,5) fields and a memoryview for
+    length-delimited(2) fields. Groups (3,4) are rejected — Caffe never
+    emits them."""
+    import struct as _struct
+
+    pos, n = 0, len(buf)
+    mv = memoryview(buf)
+    while pos < n:
+        tag, pos = _uvarint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, pos = _uvarint(buf, pos)
+            yield field, wt, v
+        elif wt == 2:
+            ln, pos = _uvarint(buf, pos)
+            if pos + ln > n:
+                raise ValueError(
+                    f"caffe_converter: truncated protobuf (field {field} "
+                    f"declares {ln} bytes, {n - pos} remain)")
+            yield field, wt, mv[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            yield field, wt, _struct.unpack_from("<I", buf, pos)[0]
+            pos += 4
+        elif wt == 1:
+            yield field, wt, _struct.unpack_from("<Q", buf, pos)[0]
+            pos += 8
+        else:
+            raise ValueError(f"caffe_converter: unsupported protobuf wire "
+                             f"type {wt} (field {field})")
+
+
+def _parse_blob(buf):
+    """BlobProto -> float32 ndarray (caffe.proto: shape=7{dim=1}, packed
+    float data=5, packed double double_data=8, legacy num/c/h/w=1..4)."""
+    import struct as _struct
+
+    import numpy as np
+
+    dims = []
+    legacy = [None] * 4  # num, channels, height, width
+    data = []
+    for field, wt, v in _pb_walk(buf):
+        if field == 7 and wt == 2:  # BlobShape
+            for f2, w2, v2 in _pb_walk(v):
+                if f2 == 1 and w2 == 2:  # packed int64 dims
+                    b2 = bytes(v2)
+                    p2 = 0
+                    while p2 < len(b2):
+                        d, p2 = _uvarint(b2, p2)
+                        dims.append(d)
+                elif f2 == 1 and w2 == 0:
+                    dims.append(v2)
+        elif field == 5:  # float data
+            if wt == 2:
+                data.append(np.frombuffer(v, dtype="<f4"))
+            else:  # unpacked fixed32
+                data.append(np.asarray(
+                    [_struct.unpack("<f", _struct.pack("<I", v))[0]],
+                    dtype=np.float32))
+        elif field == 8 and wt == 2:  # packed double data
+            data.append(np.frombuffer(v, dtype="<f8").astype(np.float32))
+        elif field in (1, 2, 3, 4) and wt == 0:
+            legacy[field - 1] = v
+    arr = (np.concatenate(data) if data
+           else np.zeros(0, np.float32)).astype(np.float32)
+    if not dims and any(x is not None for x in legacy):
+        dims = [x for x in legacy if x is not None]
+    if dims and int(np.prod(dims)) == arr.size:
+        arr = arr.reshape(dims)
+    return arr
+
+
+def read_caffemodel(data):
+    """Parse .caffemodel bytes -> ordered [(layer_name, [blobs])].
+
+    Handles both the modern ``LayerParameter layer = 100`` (name=1,
+    blobs=7) and the legacy ``V1LayerParameter layers = 2`` (name=4,
+    blobs=6) encodings of NetParameter."""
+    # NetParameter field -> that layer encoding's (name, blobs) fields
+    encodings = {100: (1, 7), 2: (4, 6)}
+    out = []
+    for field, wt, v in _pb_walk(data):
+        if field in encodings and wt == 2:
+            name_field, blob_field = encodings[field]
+            name, blobs = "", []
+            for f2, w2, v2 in _pb_walk(v):
+                if f2 == name_field and w2 == 2:
+                    name = bytes(v2).decode("utf-8")
+                elif f2 == blob_field and w2 == 2:
+                    blobs.append(_parse_blob(v2))
+            out.append((name, blobs))
+    return out
+
+
+def convert_model(prototxt_text, caffemodel_bytes):
+    """Convert a trained Caffe model: (symbol, arg_params, aux_params,
+    input_name). The reference analogue is convert_model.py:47-137 —
+    conv/fc weights map by layer name, an InnerProduct weight reshapes to
+    the symbol's inferred 2-d shape, BatchNorm statistics de-scale by the
+    running scale factor, and a following Scale layer's gamma/beta land
+    in the folded BatchNorm symbol's arguments."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    sym, input_name = convert_symbol(prototxt_text)
+    weights = dict(read_caffemodel(caffemodel_bytes))
+
+    net = parse_prototxt(prototxt_text)
+    layers = _as_list(net.get("layer")) + _as_list(net.get("layers"))
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    blob_owner = {}  # top blob name -> producing BN layer name
+
+    def put_arg(pname, arr):
+        if pname in arg_names:
+            arg_params[pname] = mx.nd.array(np.asarray(arr, np.float32))
+
+    for layer in layers:
+        ltype = str(layer.get("type", "")).upper()
+        name = layer.get("name", "")
+        blobs = weights.get(name)
+        if ltype == "BATCHNORM":
+            for t in _as_list(layer.get("top")) or [name]:
+                blob_owner[t] = name
+        if not blobs:
+            continue
+        if ltype in ("CONVOLUTION", "DECONVOLUTION", "INNERPRODUCT",
+                     "INNER_PRODUCT"):
+            w = blobs[0]
+            if ltype in ("INNERPRODUCT", "INNER_PRODUCT") and w.ndim > 2:
+                # legacy blobs carry 4-d (1,1,N,D) dims; the matrix is the
+                # trailing two
+                w = w.reshape(w.shape[-2], w.shape[-1])
+            put_arg(f"{name}_weight", w)
+            if len(blobs) > 1:
+                put_arg(f"{name}_bias", blobs[1].ravel())
+        elif ltype == "BATCHNORM":
+            sf = float(blobs[2].ravel()[0]) if len(blobs) > 2 and \
+                blobs[2].size else 1.0
+            sf = 1.0 / sf if sf != 0 else 0.0
+            if f"{name}_moving_mean" in aux_names:
+                aux_params[f"{name}_moving_mean"] = mx.nd.array(
+                    blobs[0].ravel() * sf)
+                aux_params[f"{name}_moving_var"] = mx.nd.array(
+                    blobs[1].ravel() * sf)
+            # gamma/beta default to identity unless a Scale layer follows
+            put_arg(f"{name}_gamma", np.ones_like(blobs[0].ravel()))
+            put_arg(f"{name}_beta", np.zeros_like(blobs[0].ravel()))
+        elif ltype == "SCALE":
+            bot = _as_list(layer.get("bottom"))
+            bn = blob_owner.get(bot[0]) if bot else None
+            if bn is None:
+                continue  # convert_symbol already rejected standalone Scale
+            put_arg(f"{bn}_gamma", blobs[0].ravel())
+            if len(blobs) > 1:
+                put_arg(f"{bn}_beta", blobs[1].ravel())
+    return sym, arg_params, aux_params, input_name
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("prototxt")
+    ap.add_argument("-w", "--weights", default=None,
+                    help=".caffemodel to convert alongside the symbol")
     ap.add_argument("-o", "--output", default=None,
-                    help="write symbol JSON here (default: stdout)")
+                    help="write symbol JSON here (default: stdout); with "
+                         "-w, treated as a checkpoint prefix")
     args = ap.parse_args()
     import os
 
     sys.path.insert(
         0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     with open(args.prototxt) as f:
-        symbol, _ = convert_symbol(f.read())
+        text = f.read()
+    if args.weights:
+        import mxnet_tpu as mx
+
+        with open(args.weights, "rb") as f:
+            sym, arg_params, aux_params, _ = convert_model(text, f.read())
+        prefix = args.output or os.path.splitext(args.prototxt)[0]
+        mx.model.save_checkpoint(
+            prefix, 0, sym, arg_params, aux_params)
+        print(f"wrote {prefix}-symbol.json and {prefix}-0000.params")
+        return
+    symbol, _ = convert_symbol(text)
     js = symbol.tojson()
     if args.output:
         with open(args.output, "w") as f:
