@@ -1,0 +1,138 @@
+// Build LeNet FROM OPS (no JSON load) and run training steps — the
+// reference cpp-package lenet.cpp pattern over the C ABI construction
+// tier: generated op wrappers (mxtpu_ops.hpp) -> MXSymbolCreateAtomic-
+// Symbol/Compose, MXExecutorSimpleBind allocation, and a KVStore whose
+// MXKVStoreSetUpdater callback applies SGD through MXImperativeInvoke.
+//
+// Prints "loss0 loss1" (cross-entropy before/after one update) on
+// stdout; the python test replicates the exact flow and compares.
+#include <cmath>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "mxtpu_cpp.hpp"
+#include "mxtpu_ops.hpp"
+
+using mxtpu::cpp::Check;
+using mxtpu::cpp::NDArray;
+using mxtpu::cpp::Symbol;
+namespace op = mxtpu::cpp::op;
+
+static const float kLR = 0.01f;
+
+// SGD through the imperative registry: local -= lr * recv (in place)
+static void sgd_updater(int key, NDArrayHandle recv, NDArrayHandle local,
+                        void* /*unused*/) {
+  NDArrayHandle inputs[2] = {local, recv};
+  int num_outputs = 1;
+  NDArrayHandle outs_storage[1] = {local};
+  NDArrayHandle* outputs = outs_storage;
+  const char* keys[] = {"lr", "wd"};
+  char lr_s[32];
+  snprintf(lr_s, sizeof lr_s, "%f", kLR);
+  const char* vals[] = {lr_s, "0.0"};
+  Check(MXImperativeInvoke(
+      mxtpu::cpp::detail::CreatorByName("sgd_update"), 2, inputs,
+      &num_outputs, &outputs, 2, keys, vals));
+}
+
+int main() {
+  const uint32_t B = 8, CLS = 10;
+
+  // ---- LeNet from ops (models/lenet structure) ----
+  Symbol data = Symbol::Variable("data");
+  Symbol c1 = op::Convolution("conv1", data, "(5, 5)", 20);
+  Symbol a1 = op::Activation("act1", c1, "tanh");
+  Symbol p1 = op::Pooling("pool1", a1, /*cudnn_off=*/false,
+                          /*global_pool=*/false, "(2, 2)", "", "max",
+                          "valid", "(2, 2)");
+  Symbol c2 = op::Convolution("conv2", p1, "(5, 5)", 50);
+  Symbol a2 = op::Activation("act2", c2, "tanh");
+  Symbol p2 = op::Pooling("pool2", a2, /*cudnn_off=*/false,
+                          /*global_pool=*/false, "(2, 2)", "", "max",
+                          "valid", "(2, 2)");
+  Symbol fl = op::Flatten("flat", p2);
+  Symbol f1 = op::FullyConnected("fc1", fl, 500);
+  Symbol a3 = op::Activation("act3", f1, "tanh");
+  Symbol f2 = op::FullyConnected("fc2", a3, CLS);
+  Symbol net = op::SoftmaxOutput("softmax", f2);
+
+  // ---- SimpleBind: infer + allocate everything ----
+  const char* shape_names[] = {"data", "softmax_label"};
+  uint32_t shape_data[] = {B, 1, 28, 28, B};
+  uint32_t shape_idx[] = {0, 4, 5};
+  const char* req_types[] = {"write"};
+  int shared_buffer_len = -1;
+  uint32_t num_in_args = 0, num_aux = 0;
+  NDArrayHandle *in_args = nullptr, *arg_grads = nullptr, *aux = nullptr;
+  ExecutorHandle exec = nullptr;
+  Check(MXExecutorSimpleBind(
+      net.handle(), 1 /*cpu*/, 0, 0, nullptr, nullptr, nullptr,
+      0, nullptr, req_types, 2, shape_names, shape_data, shape_idx,
+      0, nullptr, nullptr, 0, nullptr, nullptr, 0, nullptr,
+      &shared_buffer_len, nullptr, nullptr, nullptr, nullptr,
+      &num_in_args, &in_args, &arg_grads, &num_aux, &aux, nullptr, &exec));
+
+  std::vector<std::string> arg_names = net.ListArguments();
+  if (arg_names.size() != num_in_args) {
+    fprintf(stderr, "arg count mismatch\n");
+    return 1;
+  }
+
+  // ---- deterministic params + batch (mirrored by the python test) ----
+  std::vector<float> buf;
+  for (uint32_t i = 0; i < num_in_args; ++i) {
+    NDArray a(in_args[i], false);
+    buf.resize(a.size());
+    if (arg_names[i] == "data") {
+      for (size_t j = 0; j < buf.size(); ++j) buf[j] = (j % 29) / 29.0f;
+    } else if (arg_names[i] == "softmax_label") {
+      for (size_t j = 0; j < buf.size(); ++j) buf[j] = (float)(j % CLS);
+    } else {
+      for (size_t j = 0; j < buf.size(); ++j)
+        buf[j] = 0.05f * std::sin((double)(j % 1997));
+    }
+    a.SyncCopyFromCPU(buf.data(), buf.size());
+  }
+
+  // ---- kvstore with the C updater ----
+  KVStoreHandle kv;
+  Check(MXKVStoreCreate("local", &kv));
+  Check(MXKVStoreSetUpdater(kv, sgd_updater, nullptr));
+  std::vector<int> pkeys;
+  for (uint32_t i = 0; i < num_in_args; ++i) {
+    if (arg_names[i] == "data" || arg_names[i] == "softmax_label") continue;
+    int k = (int)i;
+    Check(MXKVStoreInit(kv, 1, &k, &in_args[i]));
+    pkeys.push_back(k);
+  }
+
+  auto loss = [&]() -> double {
+    uint32_t n_out;
+    NDArrayHandle* outs;
+    Check(MXExecutorOutputs(exec, &n_out, &outs));
+    NDArray probs(outs[0]);
+    std::vector<float> p(probs.size());
+    probs.SyncCopyToCPU(p.data(), p.size());
+    double total = 0;
+    for (uint32_t b = 0; b < B; ++b)
+      total += -std::log((double)p[b * CLS + (b % CLS)] + 1e-12);
+    return total / B;
+  };
+
+  Check(MXExecutorForward(exec, 1));
+  double loss0 = loss();
+  Check(MXExecutorBackward(exec, 0, nullptr));
+  for (int k : pkeys) {
+    Check(MXKVStorePush(kv, 1, &k, &arg_grads[k], 0));
+    Check(MXKVStorePull(kv, 1, &k, &in_args[k], 0));
+  }
+  Check(MXExecutorForward(exec, 1));
+  double loss1 = loss();
+  printf("%.6f %.6f\n", loss0, loss1);
+
+  MXKVStoreFree(kv);
+  MXExecutorFree(exec);
+  return 0;
+}

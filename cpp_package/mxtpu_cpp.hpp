@@ -15,6 +15,7 @@
 #define MXTPU_CPP_HPP_
 
 #include <cstdint>
+#include <cstdio>
 #include <map>
 #include <stdexcept>
 #include <string>
@@ -204,6 +205,17 @@ class Symbol {
   std::vector<std::string> ListAuxiliaryStates() const {
     return list_impl(MXSymbolListAuxiliaryStates);
   }
+  // construction tier (reference Symbol::Variable / operator())
+  static Symbol Variable(const std::string& name) {
+    SymbolHandle h;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+  Symbol Copy() const {
+    SymbolHandle h;
+    Check(MXSymbolCopy(handle_, &h));
+    return Symbol(h);
+  }
 
  private:
   template <typename F>
@@ -215,6 +227,84 @@ class Symbol {
   }
   SymbolHandle handle_;
 };
+
+namespace detail {
+// plumbing shared by the generated op wrappers (mxtpu_ops.hpp)
+using ParamMap = std::vector<std::pair<std::string, std::string>>;
+
+inline std::string str(int64_t v) { return std::to_string(v); }
+inline std::string str(double v) {
+  // std::to_string's fixed 6 decimals would zero small values (eps=1e-10)
+  char buf[32];
+  snprintf(buf, sizeof buf, "%.17g", v);
+  return buf;
+}
+inline std::string str(bool v) { return v ? "1" : "0"; }
+
+inline AtomicSymbolCreator CreatorByName(const std::string& op) {
+  static std::map<std::string, AtomicSymbolCreator> table = [] {
+    std::map<std::string, AtomicSymbolCreator> t;
+    uint32_t n;
+    AtomicSymbolCreator* creators;
+    Check(MXSymbolListAtomicSymbolCreators(&n, &creators));
+    for (uint32_t i = 0; i < n; ++i) {
+      const char* name;
+      Check(MXSymbolGetAtomicSymbolName(creators[i], &name));
+      t[name] = creators[i];
+    }
+    return t;
+  }();
+  auto it = table.find(op);
+  if (it == table.end())
+    throw std::runtime_error("no such operator: " + op);
+  return it->second;
+}
+
+inline Symbol MakeAtomic(const std::string& op, const ParamMap& params) {
+  std::vector<const char*> keys, vals;
+  for (auto& kv : params) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  SymbolHandle h;
+  Check(MXSymbolCreateAtomicSymbol(CreatorByName(op),
+                                   (uint32_t)params.size(), keys.data(),
+                                   vals.data(), &h));
+  return Symbol(h);
+}
+
+// fixed-arity op: keyword-wire the provided inputs (missing ones become
+// auto-created variables named {symbol_name}_{arg}, as in Python)
+inline Symbol CreateOp(const std::string& op, const std::string& name,
+                       size_t num_args, const char** arg_keys,
+                       const Symbol* const* inputs, const ParamMap& params) {
+  Symbol s = MakeAtomic(op, params);
+  std::vector<const char*> keys;
+  std::vector<SymbolHandle> args;
+  for (size_t i = 0; i < num_args; ++i) {
+    if (inputs[i] != nullptr) {
+      keys.push_back(arg_keys[i]);
+      args.push_back(inputs[i]->handle());
+    }
+  }
+  Check(MXSymbolCompose(s.handle(), name.c_str(), (uint32_t)args.size(),
+                        keys.empty() ? nullptr : keys.data(),
+                        args.empty() ? nullptr : args.data()));
+  return s;
+}
+
+// variadic op (Concat, add_n, ...): positional inputs
+inline Symbol CreateOpN(const std::string& op, const std::string& name,
+                        const std::vector<const Symbol*>& inputs,
+                        const ParamMap& params) {
+  Symbol s = MakeAtomic(op, params);
+  std::vector<SymbolHandle> args;
+  for (auto* in : inputs) args.push_back(in->handle());
+  Check(MXSymbolCompose(s.handle(), name.c_str(), (uint32_t)args.size(),
+                        nullptr, args.empty() ? nullptr : args.data()));
+  return s;
+}
+}  // namespace detail
 
 class Executor {
  public:

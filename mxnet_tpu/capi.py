@@ -410,3 +410,172 @@ def batch_label(batch, index):
 
 def batch_pad(batch):
     return int(getattr(batch, "pad", 0) or 0)
+
+
+# ---------------------------------------------------------------------------
+# graph construction tier (reference c_api.h:728-1000): build symbols from
+# ops instead of loading JSON — the tier every language binding sits on
+# ---------------------------------------------------------------------------
+def sym_create_variable(name):
+    from . import symbol
+
+    return symbol.Variable(name)
+
+
+def sym_create_atomic(op_name, keys, vals):
+    """MXSymbolCreateAtomicSymbol: an op symbol with params set but inputs
+    not yet wired — MXSymbolCompose attaches them. Modeled as a Symbol
+    subclass with no outputs that compose() fills IN PLACE, so the C
+    handle's object identity survives composition (the reference mutates
+    the heap Symbol the same way, c_api_symbolic.cc Compose)."""
+    from . import symbol
+
+    s = symbol.Symbol([])
+    s._atomic_op = str(op_name)
+    s._atomic_attrs = dict(zip(keys, vals))
+    return s
+
+
+def sym_compose(sym, name, keys, args):
+    """MXSymbolCompose: wire inputs into an atomic symbol in place.
+
+    ``keys`` empty = positional args (in op arg order); otherwise each arg
+    is keyword-wired. Mirrors nnvm::Symbol::Compose semantics for the
+    single-op case the bindings generate."""
+    from . import symbol
+    from .ops import registry
+
+    op_name = getattr(sym, "_atomic_op", None)
+    if op_name is None:
+        raise MXNetError(
+            "MXSymbolCompose: handle was not created by "
+            "MXSymbolCreateAtomicSymbol (already composed, or a variable)"
+        )
+    opdef = registry.get(op_name)
+    attrs = dict(sym._atomic_attrs)
+    if keys:
+        params = opdef.parse_params(
+            {k: v for k, v in attrs.items()}, strict=False)
+        arg_names = list(opdef.arg_names(params))
+        by_key = dict(zip(keys, args))
+        unknown = [k for k in by_key if k not in arg_names]
+        if unknown:
+            raise MXNetError(
+                f"MXSymbolCompose: {op_name} has no inputs {unknown}; "
+                f"expected from {arg_names}"
+            )
+        ordered = [by_key.get(an) for an in arg_names]
+        while ordered and ordered[-1] is None:
+            ordered.pop()
+    else:
+        ordered = list(args)
+    composed = symbol._create(op_name, ordered, attrs, name=name or None)
+    sym._outputs = composed._outputs
+    sym._atomic_op = None
+    return None
+
+
+def sym_create_group(syms):
+    from . import symbol
+
+    return symbol.Group(list(syms))
+
+
+def sym_copy(sym):
+    from . import symbol
+
+    return symbol.fromjson(sym.tojson())
+
+
+def exec_simple_bind(sym, dev_type, dev_id, g2c_keys, g2c_dev_types,
+                     g2c_dev_ids, req_names, req_types, shape_names,
+                     shapes, dtype_names, dtype_codes):
+    """MXExecutorSimpleBind core: infer + allocate. Returns
+    (exe, in_args, arg_grads (None where grad_req null), aux_states)."""
+    from .base import dtype_name
+    from .executor import Executor
+
+    if req_names:
+        grad_req = dict(zip(req_names, req_types))
+    elif req_types:
+        grad_req = req_types[0] if len(req_types) == 1 else list(req_types)
+    else:
+        grad_req = "write"
+    group2ctx = {
+        k: _ctx(t, i) for k, t, i in zip(g2c_keys, g2c_dev_types, g2c_dev_ids)
+    } or None
+    type_dict = {
+        n: dtype_name(c) for n, c in zip(dtype_names, dtype_codes)
+    } or None
+    kwargs = {n: tuple(int(d) for d in s)
+              for n, s in zip(shape_names, shapes)}
+    exe = Executor.simple_bind(
+        sym, _ctx(dev_type, dev_id), grad_req=grad_req,
+        type_dict=type_dict, group2ctx=group2ctx, **kwargs)
+    return exe, list(exe.arg_arrays), list(exe.grad_arrays), \
+        list(exe.aux_arrays)
+
+
+def kv_set_updater(kv, updater):
+    """MXKVStoreSetUpdater: ``updater`` is a python callable built by the
+    C layer around the client's function pointer; it receives
+    (int key, NDArray recv, NDArray local)."""
+    def _upd(key, recv, local):
+        k = int(str(key)) if not isinstance(key, int) else key
+        updater(k, recv, local)
+
+    kv._set_updater(_upd)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# autograd tier (reference c_api.h:570-660 MXAutograd*)
+# ---------------------------------------------------------------------------
+def autograd_set_recording(is_recording):
+    from . import autograd
+
+    prev = autograd.is_recording()
+    autograd.set_recording(bool(is_recording))
+    return int(prev)
+
+
+def autograd_set_training(train_mode):
+    from . import autograd
+
+    prev = autograd.is_training()
+    autograd.set_training(bool(train_mode))
+    return int(prev)
+
+
+def autograd_mark_variables(variables, gradients, req_codes):
+    from . import autograd
+
+    autograd.mark_variables(
+        list(variables), list(gradients),
+        [_REQ_FROM_CODE[int(c)] for c in req_codes])
+    return None
+
+
+def autograd_backward(outputs, head_grads, retain_graph):
+    from . import autograd
+    from .ndarray import ones
+
+    grads = None
+    if head_grads:
+        # a None entry means the default seed for that head (reference
+        # MXAutogradBackward permits per-output NULL = ones)
+        grads = [
+            g if g is not None else ones(o.shape, dtype=o.dtype)
+            for g, o in zip(head_grads, outputs)
+        ]
+    autograd.backward(list(outputs), grads, retain_graph=bool(retain_graph))
+    return None
+
+
+def nd_get_grad(nd):
+    from .base import MXNetError as _E
+
+    g = getattr(nd, "grad", None)
+    if g is None:
+        raise _E("NDArray has no gradient buffer (mark_variables first)")
+    return g

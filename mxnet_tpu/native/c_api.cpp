@@ -36,6 +36,8 @@ struct Handle {
   std::vector<uint32_t> ndims[3];
   std::vector<const uint32_t*> dptrs[3];
   std::string json;
+  // simple-bind scratch: the returned in_args/arg_grads/aux handle arrays
+  std::vector<void*> hvec[3];
   ~Handle() {
     if (obj || obj2) {
       GIL gil;
@@ -1079,6 +1081,348 @@ static int batch_part(DataIterHandle handle, const char* fn,
     return -1;
   }
   PyObject* r = capi_call(fn, Py_BuildValue("(Oi)", H(handle)->obj2, 0));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+/* ---------------- graph construction tier ---------------- */
+
+static PyObject* str_list(uint32_t n, const char** arr) {
+  PyObject* l = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(arr ? arr[i] : ""));
+  return l;
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               uint32_t num_param, const char** keys,
+                               const char** vals, SymbolHandle* out) {
+  MXTPU_API_BEGIN();
+  if (!mxtpu::ensure_op_table()) break;
+  size_t idx = (size_t)(uintptr_t)creator;
+  if (idx == 0 || idx > mxtpu::op_table().size()) {
+    g_last_error = "invalid AtomicSymbolCreator";
+    return -1;
+  }
+  PyObject* r = capi_call(
+      "sym_create_atomic",
+      Py_BuildValue("(sNN)", mxtpu::op_table()[idx - 1].c_str(),
+                    str_list(num_param, keys), str_list(num_param, vals)));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("sym_create_variable", Py_BuildValue("(s)", name));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char* name, uint32_t num_args,
+                    const char** keys, SymbolHandle* args) {
+  MXTPU_API_BEGIN();
+  PyObject* keys_l;
+  if (keys) {
+    keys_l = str_list(num_args, keys);
+  } else {
+    keys_l = PyList_New(0);
+  }
+  PyObject* args_l = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    Py_INCREF(H(args[i])->obj);
+    PyList_SET_ITEM(args_l, i, H(args[i])->obj);
+  }
+  PyObject* r = capi_call(
+      "sym_compose",
+      Py_BuildValue("(OsNN)", H(sym)->obj, name ? name : "", keys_l,
+                    args_l));
+  if (!r) break;
+  Py_DECREF(r);
+  MXTPU_API_END();
+}
+
+int MXSymbolCreateGroup(uint32_t num_symbols, SymbolHandle* symbols,
+                        SymbolHandle* out) {
+  MXTPU_API_BEGIN();
+  PyObject* l = PyList_New(num_symbols);
+  for (uint32_t i = 0; i < num_symbols; ++i) {
+    Py_INCREF(H(symbols[i])->obj);
+    PyList_SET_ITEM(l, i, H(symbols[i])->obj);
+  }
+  PyObject* r = capi_call("sym_create_group", Py_BuildValue("(N)", l));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle* out) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("sym_copy", Py_BuildValue("(O)", H(symbol)->obj));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const uint32_t num_g2c_keys, const char** g2c_keys,
+    const int* g2c_dev_types, const int* g2c_dev_ids,
+    const uint32_t provided_grad_req_list_len,
+    const char** provided_grad_req_names,
+    const char** provided_grad_req_types,
+    const uint32_t num_provided_arg_shapes,
+    const char** provided_arg_shape_names,
+    const uint32_t* provided_arg_shape_data,
+    const uint32_t* provided_arg_shape_idx,
+    const uint32_t num_provided_arg_dtypes,
+    const char** provided_arg_dtype_names, const int* provided_arg_dtypes,
+    const uint32_t num_provided_arg_stypes,
+    const char** provided_arg_stype_names, const int* provided_arg_stypes,
+    const uint32_t num_shared_arg_names,
+    const char** shared_arg_name_list, int* shared_buffer_len,
+    const char** shared_buffer_name_list,
+    NDArrayHandle* shared_buffer_handle_list,
+    const char*** updated_shared_buffer_name_list,
+    NDArrayHandle** updated_shared_buffer_handle_list,
+    uint32_t* num_in_args, NDArrayHandle** in_args,
+    NDArrayHandle** arg_grads, uint32_t* num_aux_states,
+    NDArrayHandle** aux_states, ExecutorHandle shared_exec_handle,
+    ExecutorHandle* out) {
+  MXTPU_API_BEGIN();
+  (void)provided_arg_stype_names;
+  (void)shared_arg_name_list;
+  (void)shared_buffer_name_list;
+  (void)shared_buffer_handle_list;
+  for (uint32_t i = 0; i < num_provided_arg_stypes; ++i) {
+    if (provided_arg_stypes[i] != 0) {  // kDefaultStorage only
+      g_last_error = "MXExecutorSimpleBind: sparse storage types are not "
+                     "supported (dense kDefaultStorage only)";
+      return -1;
+    }
+  }
+  if (num_shared_arg_names != 0 ||
+      (shared_buffer_len && *shared_buffer_len >= 0) ||
+      shared_exec_handle != nullptr) {
+    g_last_error = "MXExecutorSimpleBind: shared-arg / shared-buffer / "
+                   "shared-exec reuse is not supported; pass 0/NULL/-1";
+    return -1;
+  }
+  if (updated_shared_buffer_name_list)
+    *updated_shared_buffer_name_list = nullptr;
+  if (updated_shared_buffer_handle_list)
+    *updated_shared_buffer_handle_list = nullptr;
+  // shapes arrive CSR-style: idx[i]..idx[i+1] indexes into the flat data
+  PyObject* shapes_l = PyList_New(num_provided_arg_shapes);
+  for (uint32_t i = 0; i < num_provided_arg_shapes; ++i) {
+    uint32_t lo = provided_arg_shape_idx[i];
+    uint32_t hi = provided_arg_shape_idx[i + 1];
+    PyObject* t = PyTuple_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(t, j - lo,
+                       PyLong_FromUnsignedLong(provided_arg_shape_data[j]));
+    PyList_SET_ITEM(shapes_l, i, t);
+  }
+  PyObject* g2c_t = PyList_New(num_g2c_keys);
+  PyObject* g2c_i = PyList_New(num_g2c_keys);
+  for (uint32_t i = 0; i < num_g2c_keys; ++i) {
+    PyList_SET_ITEM(g2c_t, i, PyLong_FromLong(g2c_dev_types[i]));
+    PyList_SET_ITEM(g2c_i, i, PyLong_FromLong(g2c_dev_ids[i]));
+  }
+  PyObject* dt_l = PyList_New(num_provided_arg_dtypes);
+  for (uint32_t i = 0; i < num_provided_arg_dtypes; ++i)
+    PyList_SET_ITEM(dt_l, i, PyLong_FromLong(provided_arg_dtypes[i]));
+  PyObject* r = capi_call(
+      "exec_simple_bind",
+      Py_BuildValue(
+          "(OiiNNNNNNNNN)", H(symbol_handle)->obj, dev_type, dev_id,
+          str_list(num_g2c_keys, g2c_keys), g2c_t, g2c_i,
+          // names may be NULL with len>0: a positional per-arg req list
+          str_list(provided_grad_req_names ? provided_grad_req_list_len
+                                           : 0u,
+                   provided_grad_req_names),
+          str_list(provided_grad_req_list_len ? provided_grad_req_list_len
+                                              : (provided_grad_req_types
+                                                     ? 1u : 0u),
+                   provided_grad_req_types),
+          str_list(num_provided_arg_shapes, provided_arg_shape_names),
+          shapes_l,
+          str_list(num_provided_arg_dtypes, provided_arg_dtype_names),
+          dt_l));
+  if (!r) break;
+  // r = (exe, in_args, arg_grads, aux_states)
+  Handle* h = new Handle();
+  h->obj = PySequence_GetItem(r, 0);
+  for (int g = 0; g < 3; ++g) {
+    PyObject* lst = PySequence_GetItem(r, g + 1);
+    Py_ssize_t n = PySequence_Size(lst);
+    h->hvec[g].clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* it = PySequence_GetItem(lst, i);
+      if (it == Py_None) {
+        Py_DECREF(it);
+        h->hvec[g].push_back(nullptr);
+      } else {
+        Handle* nh = new Handle();
+        nh->obj = it;  // steals the new reference
+        h->hvec[g].push_back(nh);
+      }
+    }
+    Py_DECREF(lst);
+  }
+  Py_DECREF(r);
+  *num_in_args = (uint32_t)h->hvec[0].size();
+  *in_args = h->hvec[0].data();
+  *arg_grads = h->hvec[1].data();
+  *num_aux_states = (uint32_t)h->hvec[2].size();
+  *aux_states = h->hvec[2].data();
+  *out = h;
+  MXTPU_API_END();
+}
+
+/* ---------------- KVStore updater + autograd ---------------- */
+
+namespace mxtpu {
+struct UpdaterCtx {
+  MXKVStoreUpdater* fn;
+  void* user;
+};
+
+// trampoline: python calls this with (key, recv_nd, local_nd); wraps the
+// NDArrays in temporary C handles valid for the duration of the call
+static PyObject* kv_updater_tramp(PyObject* self, PyObject* args) {
+  int key;
+  PyObject* recv;
+  PyObject* local;
+  if (!PyArg_ParseTuple(args, "iOO", &key, &recv, &local)) return nullptr;
+  UpdaterCtx* ctx =
+      (UpdaterCtx*)PyCapsule_GetPointer(self, "mxtpu.updater");
+  if (!ctx) return nullptr;
+  Handle recv_h;
+  Handle local_h;
+  Py_INCREF(recv);
+  recv_h.obj = recv;
+  Py_INCREF(local);
+  local_h.obj = local;
+  // the client callback may call back into MX* APIs that take the GIL;
+  // release it around the call (handles keep their refs)
+  {
+    PyThreadState* st = PyEval_SaveThread();
+    ctx->fn(key, &recv_h, &local_h, ctx->user);
+    PyEval_RestoreThread(st);
+  }
+  Py_RETURN_NONE;
+}
+
+static void updater_capsule_free(PyObject* cap) {
+  delete (UpdaterCtx*)PyCapsule_GetPointer(cap, "mxtpu.updater");
+}
+
+static PyMethodDef kv_updater_def = {
+    "mxtpu_kv_updater", kv_updater_tramp, METH_VARARGS,
+    "C kvstore updater trampoline"};
+}  // namespace mxtpu
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void* updater_handle) {
+  MXTPU_API_BEGIN();
+  auto* ctx = new mxtpu::UpdaterCtx{updater, updater_handle};
+  PyObject* cap =
+      PyCapsule_New(ctx, "mxtpu.updater", mxtpu::updater_capsule_free);
+  PyObject* fn = PyCFunction_New(&mxtpu::kv_updater_def, cap);
+  Py_DECREF(cap);  // fn holds it
+  PyObject* r = capi_call("kv_set_updater",
+                          Py_BuildValue("(ON)", H(handle)->obj, fn));
+  if (!r) break;
+  Py_DECREF(r);
+  MXTPU_API_END();
+}
+
+int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  MXTPU_API_BEGIN();
+  PyObject* r =
+      capi_call("autograd_set_recording", Py_BuildValue("(i)", is_recording));
+  if (!r) break;
+  if (prev) *prev = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  MXTPU_API_END();
+}
+
+int MXAutogradSetIsTraining(int is_training, int* prev) {
+  MXTPU_API_BEGIN();
+  PyObject* r =
+      capi_call("autograd_set_training", Py_BuildValue("(i)", is_training));
+  if (!r) break;
+  if (prev) *prev = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  MXTPU_API_END();
+}
+
+int MXAutogradMarkVariables(uint32_t num_var, NDArrayHandle* var_handles,
+                            uint32_t* reqs_array,
+                            NDArrayHandle* grad_handles) {
+  MXTPU_API_BEGIN();
+  PyObject* vars_l = PyList_New(num_var);
+  PyObject* grads_l = PyList_New(num_var);
+  PyObject* reqs_l = PyList_New(num_var);
+  for (uint32_t i = 0; i < num_var; ++i) {
+    Py_INCREF(H(var_handles[i])->obj);
+    PyList_SET_ITEM(vars_l, i, H(var_handles[i])->obj);
+    Py_INCREF(H(grad_handles[i])->obj);
+    PyList_SET_ITEM(grads_l, i, H(grad_handles[i])->obj);
+    PyList_SET_ITEM(reqs_l, i, PyLong_FromUnsignedLong(reqs_array[i]));
+  }
+  PyObject* r = capi_call("autograd_mark_variables",
+                          Py_BuildValue("(NNN)", vars_l, grads_l, reqs_l));
+  if (!r) break;
+  Py_DECREF(r);
+  MXTPU_API_END();
+}
+
+int MXAutogradBackward(uint32_t num_output, NDArrayHandle* output_handles,
+                       NDArrayHandle* ograd_handles, int retain_graph) {
+  MXTPU_API_BEGIN();
+  PyObject* outs_l = PyList_New(num_output);
+  for (uint32_t i = 0; i < num_output; ++i) {
+    Py_INCREF(H(output_handles[i])->obj);
+    PyList_SET_ITEM(outs_l, i, H(output_handles[i])->obj);
+  }
+  PyObject* grads_l;
+  if (ograd_handles) {
+    grads_l = PyList_New(num_output);
+    for (uint32_t i = 0; i < num_output; ++i) {
+      // a NULL entry = default head gradient (reference contract)
+      PyObject* g = ograd_handles[i] ? H(ograd_handles[i])->obj : Py_None;
+      Py_INCREF(g);
+      PyList_SET_ITEM(grads_l, i, g);
+    }
+  } else {
+    grads_l = PyList_New(0);
+  }
+  PyObject* r =
+      capi_call("autograd_backward",
+                Py_BuildValue("(NNi)", outs_l, grads_l, retain_graph));
+  if (!r) break;
+  Py_DECREF(r);
+  MXTPU_API_END();
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out) {
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call("nd_get_grad", Py_BuildValue("(O)", H(handle)->obj));
   if (!r) break;
   Handle* h = new Handle();
   h->obj = r;

@@ -124,6 +124,63 @@ int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
                        NDArrayHandle** outputs, int num_params,
                        const char** param_keys, const char** param_vals);
 
+/* ---------------- graph construction (reference c_api.h:728-1000) -----
+ * Build symbols from ops instead of JSON: create an atomic op symbol with
+ * string params, then compose inputs into it (positional when keys is
+ * NULL, else keyword-wired). This is the tier every language binding's
+ * generated op wrappers sit on (cpp-package OpWrapperGenerator.py). */
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               uint32_t num_param, const char** keys,
+                               const char** vals, SymbolHandle* out);
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out);
+int MXSymbolCompose(SymbolHandle sym, const char* name, uint32_t num_args,
+                    const char** keys, SymbolHandle* args);
+int MXSymbolCreateGroup(uint32_t num_symbols, SymbolHandle* symbols,
+                        SymbolHandle* out);
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle* out);
+
+/* Reference MXExecutorSimpleBind (c_api.h:1232): infer shapes/dtypes and
+ * allocate every array. Sparse storage types, shared-arg/shared-buffer
+ * reuse and shared_exec are not supported — pass 0/NULL/-1 (the values
+ * the reference's own dense single-executor clients pass). Returned
+ * handle arrays live in the executor's scratch; each entry (NULL for a
+ * null-grad_req gradient) is a NEW reference to MXNDArrayFree. */
+int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const uint32_t num_g2c_keys, const char** g2c_keys,
+    const int* g2c_dev_types, const int* g2c_dev_ids,
+    const uint32_t provided_grad_req_list_len,
+    const char** provided_grad_req_names,
+    const char** provided_grad_req_types,
+    const uint32_t num_provided_arg_shapes,
+    const char** provided_arg_shape_names,
+    const uint32_t* provided_arg_shape_data,
+    const uint32_t* provided_arg_shape_idx,
+    const uint32_t num_provided_arg_dtypes,
+    const char** provided_arg_dtype_names, const int* provided_arg_dtypes,
+    const uint32_t num_provided_arg_stypes,
+    const char** provided_arg_stype_names, const int* provided_arg_stypes,
+    const uint32_t num_shared_arg_names,
+    const char** shared_arg_name_list, int* shared_buffer_len,
+    const char** shared_buffer_name_list,
+    NDArrayHandle* shared_buffer_handle_list,
+    const char*** updated_shared_buffer_name_list,
+    NDArrayHandle** updated_shared_buffer_handle_list,
+    uint32_t* num_in_args, NDArrayHandle** in_args,
+    NDArrayHandle** arg_grads, uint32_t* num_aux_states,
+    NDArrayHandle** aux_states, ExecutorHandle shared_exec_handle,
+    ExecutorHandle* out);
+
+/* ---------------- autograd (reference c_api.h:570-660) ---------------- */
+int MXAutogradSetIsRecording(int is_recording, int* prev);
+int MXAutogradSetIsTraining(int is_training, int* prev);
+int MXAutogradMarkVariables(uint32_t num_var, NDArrayHandle* var_handles,
+                            uint32_t* reqs_array,
+                            NDArrayHandle* grad_handles);
+int MXAutogradBackward(uint32_t num_output, NDArrayHandle* output_handles,
+                       NDArrayHandle* ograd_handles, int retain_graph);
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out);
+
 /* ---------------- NDArray views ---------------- */
 int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
                      NDArrayHandle* out);
@@ -137,7 +194,14 @@ int MXSymbolGetAttr(SymbolHandle symbol, const char* key, const char** out,
 int MXSymbolSetAttr(SymbolHandle symbol, const char* key, const char* value);
 
 /* ---------------- KVStore (reference c_api.h MXKVStore*) ---------------- */
+/* the per-key update callback (reference c_api.h:1482): recv is the
+ * pushed gradient, local the stored weight to update in place; both
+ * handles are valid only for the duration of the call */
+typedef void (MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                NDArrayHandle local, void* handle);
 int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void* updater_handle);
 int MXKVStoreFree(KVStoreHandle handle);
 int MXKVStoreInit(KVStoreHandle handle, uint32_t num, const int* keys,
                   NDArrayHandle* vals);

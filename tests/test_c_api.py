@@ -465,3 +465,43 @@ def test_c_api_kvstore_recordio_dataiter(amalgamated, tmp_path):
     assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0 and has.value == 1
     assert lib.MXDataIterFree(it) == 0
     np.testing.assert_array_equal(np.concatenate(rows), data)
+
+
+def test_capi_construction_and_autograd_surface():
+    """Python half of the construction + autograd tiers (the C functions
+    are thin marshalling over these; the C end-to-end path is covered by
+    cpp_package's lenet_train example test)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import capi
+
+    # atomic + compose, keyword-wired
+    s = capi.sym_create_atomic("FullyConnected", ["num_hidden"], ["4"])
+    d = capi.sym_create_variable("data")
+    capi.sym_compose(s, "fc", ["data"], [d])
+    assert s.list_arguments() == ["data", "fc_weight", "fc_bias"]
+    # composing twice refuses
+    import pytest as _pytest
+    from mxnet_tpu.base import MXNetError
+
+    with _pytest.raises(MXNetError, match="already composed"):
+        capi.sym_compose(s, "fc2", [], [d])
+
+    # simple_bind allocates; null grad_req leaves gradient slots empty
+    exe, in_args, arg_grads, aux = capi.exec_simple_bind(
+        s, 1, 0, [], [], [], ["data", "fc_weight", "fc_bias"],
+        ["null", "write", "write"], ["data"], [(2, 3)], [], [])
+    assert [a.shape for a in in_args] == [(2, 3), (4, 3), (4,)]
+    assert arg_grads[0] is None and arg_grads[1] is not None
+
+    # autograd tier
+    x = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    g = mx.nd.zeros((2, 2))
+    capi.autograd_mark_variables([x], [g], [1])
+    prev = capi.autograd_set_recording(1)
+    y = (x * x).sum()
+    capi.autograd_set_recording(prev)
+    capi.autograd_backward([y], [], 0)
+    got = capi.nd_get_grad(x).asnumpy()
+    np.testing.assert_allclose(got, 2 * x.asnumpy(), rtol=1e-5)
