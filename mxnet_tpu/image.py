@@ -95,10 +95,13 @@ def color_normalize(src, mean, std=None):
 
 
 def random_size_crop(src, size, min_area, ratio, interp=2):
+    """``min_area`` may be a scalar lower bound (upper = 1.0) or an
+    (min, max) random-area window (the reference's min/max_random_area)."""
     h, w = src.shape[:2]
     area = w * h
+    lo, hi = (min_area, 1.0) if np.isscalar(min_area) else min_area
     for _ in range(10):
-        new_area = random.uniform(min_area, 1.0) * area
+        new_area = random.uniform(lo, hi) * area
         new_ratio = random.uniform(*ratio)
         new_w = int(np.sqrt(new_area * new_ratio))
         new_h = int(np.sqrt(new_area / new_ratio))
@@ -167,23 +170,159 @@ def ColorNormalizeAug(mean, std):
     return aug
 
 
+# ---------------------------------------------------------------------------
+# DefaultImageAugmentParam pipeline pieces (reference
+# src/io/image_aug_default.cc:25-188): affine (rotate + shear + random
+# scale + aspect), pad, random-crop-size crop, HSL jitter. These helpers
+# operate on HWC uint8 RGB numpy images and are shared by ImageIter's
+# augmenter list and the python ImageRecordIter plane; the native plane
+# (native/io_plane.cpp) replicates the same math in C++.
+# ---------------------------------------------------------------------------
+def affine_matrix(rs, h, w, max_rotate_angle=0, rotate=-1,
+                  max_shear_ratio=0.0, max_random_scale=1.0,
+                  min_random_scale=1.0, max_aspect_ratio=0.0,
+                  min_img_size=0.0, max_img_size=1e10):
+    """Draw the reference's affine transform: returns (M 2x3, new_w, new_h).
+
+    Matches image_aug_default.cc:202-251 exactly: shear m in [-msr, msr],
+    integer angle in [-mra, mra] (a fixed ``rotate`` overrides), scale in
+    [min_rs, max_rs], aspect in [1-mar, 1+mar]; hs = 2*scale/(1+ratio),
+    ws = ratio*hs; output size = clamp(scale * dim, min/max_img_size)."""
+    shear = rs.uniform(0, 1) * max_shear_ratio * 2 - max_shear_ratio
+    angle = int(rs.randint(-max_rotate_angle, max_rotate_angle + 1)) \
+        if max_rotate_angle > 0 else 0
+    if rotate > 0:
+        angle = rotate
+    a = np.cos(angle / 180.0 * np.pi)
+    b = np.sin(angle / 180.0 * np.pi)
+    scale = rs.uniform(0, 1) * (max_random_scale - min_random_scale) \
+        + min_random_scale
+    ratio = rs.uniform(0, 1) * max_aspect_ratio * 2 - max_aspect_ratio + 1
+    hs = 2 * scale / (1 + ratio)
+    ws = ratio * hs
+    new_w = max(min_img_size, min(max_img_size, scale * w))
+    new_h = max(min_img_size, min(max_img_size, scale * h))
+    M = np.zeros((2, 3), np.float32)
+    M[0, 0] = hs * a - shear * b * ws
+    M[1, 0] = -b * ws
+    M[0, 1] = hs * b + shear * a * ws
+    M[1, 1] = a * ws
+    M[0, 2] = (new_w - (M[0, 0] * w + M[0, 1] * h)) / 2
+    M[1, 2] = (new_h - (M[1, 0] * w + M[1, 1] * h)) / 2
+    return M, int(new_w), int(new_h)
+
+
+def apply_affine(img, M, new_w, new_h, fill_value=255, interp=1):
+    import cv2
+
+    return cv2.warpAffine(
+        img, M, (new_w, new_h), flags=interp, borderMode=cv2.BORDER_CONSTANT,
+        borderValue=(fill_value, fill_value, fill_value))
+
+
+def apply_hsl(img, rs, random_h=0, random_s=0, random_l=0):
+    """HSL jitter (image_aug_default.cc:299-320): add uniform deltas to the
+    H/L/S channels in HLS space with the reference's (180, 255, 255)
+    limits. ``img`` is HWC uint8 RGB."""
+    import cv2
+
+    dh = int(rs.uniform(0, 1) * random_h * 2 - random_h)
+    ds = int(rs.uniform(0, 1) * random_s * 2 - random_s)
+    dl = int(rs.uniform(0, 1) * random_l * 2 - random_l)
+    hls = cv2.cvtColor(img, cv2.COLOR_RGB2HLS).astype(np.int32)
+    for k, (delta, limit) in enumerate(((dh, 180), (dl, 255), (ds, 255))):
+        hls[:, :, k] = np.clip(hls[:, :, k] + delta, 0, limit)
+    return cv2.cvtColor(hls.astype(np.uint8), cv2.COLOR_HLS2RGB)
+
+
+def DefaultAffineAug(max_rotate_angle=0, rotate=-1, max_shear_ratio=0.0,
+                     max_random_scale=1.0, min_random_scale=1.0,
+                     max_aspect_ratio=0.0, min_img_size=0.0,
+                     max_img_size=1e10, fill_value=255, inter_method=1):
+    rs = np.random.RandomState()
+
+    def aug(src):
+        img = src.asnumpy().astype(np.uint8)
+        h, w = img.shape[:2]
+        M, nw, nh = affine_matrix(
+            rs, h, w, max_rotate_angle, rotate, max_shear_ratio,
+            max_random_scale, min_random_scale, max_aspect_ratio,
+            min_img_size, max_img_size)
+        out = apply_affine(img, M, nw, nh, fill_value, inter_method)
+        return [array(out, dtype=out.dtype)]
+
+    return aug
+
+
+def RandomHSLAug(random_h=0, random_s=0, random_l=0):
+    rs = np.random.RandomState()
+
+    def aug(src):
+        img = apply_hsl(src.asnumpy().astype(np.uint8), rs,
+                        random_h, random_s, random_l)
+        return [array(img, dtype=img.dtype)]
+
+    return aug
+
+
+def PadAug(pad, fill_value=255):
+    def aug(src):
+        import cv2
+
+        img = cv2.copyMakeBorder(
+            src.asnumpy().astype(np.uint8), pad, pad, pad, pad,
+            cv2.BORDER_CONSTANT, value=(fill_value, fill_value, fill_value))
+        return [array(img, dtype=img.dtype)]
+
+    return aug
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
-    """Create the standard augmenter list (reference CreateAugmenter)."""
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2,
+                    max_rotate_angle=0, rotate=-1, max_shear_ratio=0.0,
+                    max_random_scale=1.0, min_random_scale=1.0,
+                    max_aspect_ratio=0.0, min_random_area=0.08,
+                    max_random_area=1.0, random_h=0, random_s=0, random_l=0,
+                    pad=0, fill_value=255, min_img_size=0.0,
+                    max_img_size=1e10):
+    """Create the standard augmenter list — the reference CreateAugmenter
+    surface extended with the DefaultImageAugmentParam names
+    (image_aug_default.cc:25-188): rotation/shear/random-scale/aspect via
+    one affine warp, pad, HSL jitter, and rand_resize honoring the
+    min/max_random_area window."""
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
+    if (max_rotate_angle > 0 or rotate > 0 or max_shear_ratio > 0
+            or max_random_scale != 1.0 or min_random_scale != 1.0
+            or max_aspect_ratio != 0.0 or min_img_size != 0.0
+            or max_img_size != 1e10):
+        auglist.append(DefaultAffineAug(
+            max_rotate_angle, rotate, max_shear_ratio, max_random_scale,
+            min_random_scale, max_aspect_ratio, min_img_size, max_img_size,
+            fill_value, 1 if inter_method not in (0, 1, 2, 3, 4) else
+            inter_method))
+    if pad > 0:
+        auglist.append(PadAug(pad, fill_value))
     crop_size = (data_shape[2], data_shape[1])
     if rand_resize:
         assert rand_crop
-        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0, 4.0 / 3.0), inter_method))
+        # reference default aspect window is the asymmetric (3/4, 4/3);
+        # an explicit max_aspect_ratio widens it symmetrically
+        ratio = ((1 - max_aspect_ratio, 1 + max_aspect_ratio)
+                 if max_aspect_ratio > 0 else (3.0 / 4.0, 4.0 / 3.0))
+        auglist.append(RandomSizedCropAug(
+            crop_size, (min_random_area, max_random_area),
+            ratio, inter_method))
     elif rand_crop:
         auglist.append(RandomCropAug(crop_size, inter_method))
     else:
         auglist.append(CenterCropAug(crop_size, inter_method))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
+    if random_h or random_s or random_l:
+        auglist.append(RandomHSLAug(random_h, random_s, random_l))
     auglist.append(CastAug())
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])

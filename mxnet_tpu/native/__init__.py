@@ -63,8 +63,8 @@ def _load():
             ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64,
         ]
-        lib.mxio_load_batch.restype = ctypes.c_int64
-        lib.mxio_load_batch.argtypes = [
+        lib.mxio_load_batch2.restype = ctypes.c_int64
+        lib.mxio_load_batch2.argtypes = [
             ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64,
@@ -72,10 +72,23 @@ def _load():
             ctypes.c_int,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_float, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ]
         _lib = lib
         return _lib
+
+
+# flat order of the DefaultImageAugmentParam extension handed to
+# mxio_load_batch2 (keep in sync with io_plane.cpp's `extra` unpack)
+_AUG_EXTRA_FIELDS = (
+    "max_rotate_angle", "rotate", "max_shear_ratio", "max_random_scale",
+    "min_random_scale", "max_aspect_ratio", "min_img_size", "max_img_size",
+    "max_crop_size", "min_crop_size", "random_h", "random_s", "random_l",
+    "pad", "fill_value",
+)
+_AUG_EXTRA_DEFAULTS = (0, -1, 0.0, 1.0, 1.0, 0.0, 0.0, 1e10,
+                       -1, -1, 0, 0, 0, 0, 255)
 
 
 def available():
@@ -98,10 +111,16 @@ def scan(path):
 
 def load_batch(path, offsets, data_shape, resize=-1, rand_crop=False,
                rand_mirror=False, mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0),
-               scale=1.0, label_width=1, seed=0, num_threads=4):
+               scale=1.0, label_width=1, seed=0, num_threads=4, **aug):
     """Decode + augment a batch: (n,3,H,W) float32 data + (n,label_width)
-    labels. Slots whose decode failed stay zero (count in return value)."""
+    labels. Slots whose decode failed stay zero (count in return value).
+    ``aug`` accepts the DefaultImageAugmentParam extension fields
+    (_AUG_EXTRA_FIELDS): rotation, shear, random scale/aspect, crop-size
+    window, HSL jitter, pad/fill."""
     lib = _load()
+    unknown = set(aug) - set(_AUG_EXTRA_FIELDS)
+    if unknown:
+        raise TypeError(f"unknown augment params {sorted(unknown)}")
     offsets = np.ascontiguousarray(offsets, np.int64)
     n = len(offsets)
     c, h, w = data_shape
@@ -110,7 +129,11 @@ def load_batch(path, offsets, data_shape, resize=-1, rand_crop=False,
     labels = np.zeros((n, label_width), np.float32)
     mean = np.asarray(mean, np.float32)
     std = np.asarray(std, np.float32)
-    ok = lib.mxio_load_batch(
+    extra = np.asarray(
+        [float(aug.get(f, d))
+         for f, d in zip(_AUG_EXTRA_FIELDS, _AUG_EXTRA_DEFAULTS)],
+        np.float32)
+    ok = lib.mxio_load_batch2(
         path.encode(),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         n, h, w, int(resize), int(bool(rand_crop)), int(bool(rand_mirror)),
@@ -118,6 +141,7 @@ def load_batch(path, offsets, data_shape, resize=-1, rand_crop=False,
         std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         float(scale), int(label_width), int(seed) & (2**64 - 1),
         int(num_threads),
+        extra.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
     )

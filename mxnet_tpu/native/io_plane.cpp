@@ -146,7 +146,121 @@ struct AugmentParams {
   int rand_mirror;
   float mean[3], std[3], scale;
   int label_width;
+  // DefaultImageAugmentParam extras (image_aug_default.cc:25-128),
+  // reference names and defaults
+  int max_rotate_angle = 0;
+  int rotate = -1;
+  float max_shear_ratio = 0.f;
+  float max_random_scale = 1.f;
+  float min_random_scale = 1.f;
+  float max_aspect_ratio = 0.f;
+  float min_img_size = 0.f;
+  float max_img_size = 1e10f;
+  int max_crop_size = -1;
+  int min_crop_size = -1;
+  int random_h = 0, random_s = 0, random_l = 0;
+  int pad = 0;
+  int fill_value = 255;
+
+  bool needs_affine() const {
+    return max_rotate_angle > 0 || rotate > 0 || max_shear_ratio > 0.f ||
+           max_random_scale != 1.f || min_random_scale != 1.f ||
+           max_aspect_ratio != 0.f || min_img_size != 0.f ||
+           max_img_size != 1e10f;
+  }
 };
+
+// ---------------------------------------------------------------------------
+// Affine warp (inverse bilinear sampling, constant fill) — the
+// cv::warpAffine of the reference's rotate/shear/scale/aspect block
+// ---------------------------------------------------------------------------
+void warp_affine(const unsigned char* src, int sh, int sw, const float M[6],
+                 unsigned char* dst, int dh, int dw, int fill) {
+  // invert [a b; c d] + t
+  float a = M[0], b = M[1], tx = M[2], c = M[3], d = M[4], ty = M[5];
+  float det = a * d - b * c;
+  if (det == 0.f) det = 1e-12f;
+  float ia = d / det, ib = -b / det, ic = -c / det, id = a / det;
+  for (int y = 0; y < dh; ++y) {
+    for (int x = 0; x < dw; ++x) {
+      float fx = x - tx, fy = y - ty;
+      float sx = ia * fx + ib * fy;
+      float sy = ic * fx + id * fy;
+      unsigned char* px = dst + (size_t(y) * dw + x) * 3;
+      int x0 = int(std::floor(sx)), y0 = int(std::floor(sy));
+      if (x0 < -1 || y0 < -1 || x0 >= sw || y0 >= sh) {
+        px[0] = px[1] = px[2] = (unsigned char)fill;
+        continue;
+      }
+      float wx = sx - x0, wy = sy - y0;
+      for (int ch = 0; ch < 3; ++ch) {
+        auto at = [&](int yy, int xx) -> float {
+          if (xx < 0 || yy < 0 || xx >= sw || yy >= sh) return float(fill);
+          return src[(size_t(yy) * sw + xx) * 3 + ch];
+        };
+        float v = at(y0, x0) * (1 - wy) * (1 - wx) +
+                  at(y0, x0 + 1) * (1 - wy) * wx +
+                  at(y0 + 1, x0) * wy * (1 - wx) +
+                  at(y0 + 1, x0 + 1) * wy * wx;
+        px[ch] = (unsigned char)(v + 0.5f);
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// HSL jitter — RGB<->HLS with OpenCV's uint8 ranges (H in [0,180), L/S in
+// [0,255]) so the limits (180, 255, 255) of the reference apply directly
+// ---------------------------------------------------------------------------
+void rgb_to_hls(const unsigned char* p, float* hls) {
+  float r = p[0] / 255.f, g = p[1] / 255.f, b = p[2] / 255.f;
+  float vmax = std::max(r, std::max(g, b));
+  float vmin = std::min(r, std::min(g, b));
+  float l = (vmax + vmin) / 2.f;
+  float s = 0.f, h = 0.f;
+  float d = vmax - vmin;
+  if (d > 1e-12f) {
+    s = l < 0.5f ? d / (vmax + vmin) : d / (2.f - vmax - vmin);
+    if (vmax == r)
+      h = 60.f * (g - b) / d;
+    else if (vmax == g)
+      h = 120.f + 60.f * (b - r) / d;
+    else
+      h = 240.f + 60.f * (r - g) / d;
+    if (h < 0) h += 360.f;
+  }
+  hls[0] = h / 2.f;       // [0,180)
+  hls[1] = l * 255.f;
+  hls[2] = s * 255.f;
+}
+
+float hue_to_rgb(float p, float q, float t) {
+  if (t < 0) t += 1;
+  if (t > 1) t -= 1;
+  if (t < 1.f / 6) return p + (q - p) * 6 * t;
+  if (t < 1.f / 2) return q;
+  if (t < 2.f / 3) return p + (q - p) * (2.f / 3 - t) * 6;
+  return p;
+}
+
+void hls_to_rgb(const float* hls, unsigned char* p) {
+  float h = hls[0] * 2.f / 360.f;
+  float l = hls[1] / 255.f;
+  float s = hls[2] / 255.f;
+  float r, g, b;
+  if (s < 1e-12f) {
+    r = g = b = l;
+  } else {
+    float q = l < 0.5f ? l * (1 + s) : l + s - l * s;
+    float pq = 2 * l - q;
+    r = hue_to_rgb(pq, q, h + 1.f / 3);
+    g = hue_to_rgb(pq, q, h);
+    b = hue_to_rgb(pq, q, h - 1.f / 3);
+  }
+  p[0] = (unsigned char)(std::min(std::max(r, 0.f), 1.f) * 255.f + 0.5f);
+  p[1] = (unsigned char)(std::min(std::max(g, 0.f), 1.f) * 255.f + 0.5f);
+  p[2] = (unsigned char)(std::min(std::max(b, 0.f), 1.f) * 255.f + 0.5f);
+}
 
 // One record: IRHeader parse → decode → resize → crop → mirror → normalize →
 // CHW pack into out (3*out_h*out_w floats). Returns false on decode failure.
@@ -186,7 +300,78 @@ bool process_record(const unsigned char* rec, size_t len, const AugmentParams& p
     h = nh;
     w = nw;
   }
-  if (h < p.out_h || w < p.out_w) {  // upscale to cover the crop window
+
+  std::mt19937_64 rng(seed);
+  auto unif = [&rng]() {  // uniform [0,1)
+    return float(rng() >> 11) * (1.f / 9007199254740992.f);
+  };
+
+  // affine block (rotate + shear + random scale + aspect), matching the
+  // draw order and matrix of image_aug_default.cc:202-251
+  if (p.needs_affine()) {
+    float shear = unif() * p.max_shear_ratio * 2 - p.max_shear_ratio;
+    int angle = 0;
+    if (p.max_rotate_angle > 0)
+      angle = int(rng() % uint64_t(2 * p.max_rotate_angle + 1)) -
+              p.max_rotate_angle;
+    if (p.rotate > 0) angle = p.rotate;
+    float ca = std::cos(angle / 180.0f * 3.14159265358979f);
+    float sb = std::sin(angle / 180.0f * 3.14159265358979f);
+    float sc = unif() * (p.max_random_scale - p.min_random_scale) +
+               p.min_random_scale;
+    float ratio = unif() * p.max_aspect_ratio * 2 - p.max_aspect_ratio + 1;
+    float hs = 2 * sc / (1 + ratio);
+    float ws = ratio * hs;
+    float nwf = std::max(p.min_img_size, std::min(p.max_img_size, sc * w));
+    float nhf = std::max(p.min_img_size, std::min(p.max_img_size, sc * h));
+    int nw = int(nwf), nh = int(nhf);
+    float M[6];
+    M[0] = hs * ca - shear * sb * ws;
+    M[1] = hs * sb + shear * ca * ws;
+    M[3] = -sb * ws;
+    M[4] = ca * ws;
+    M[2] = (nwf - (M[0] * w + M[1] * h)) / 2;
+    M[5] = (nhf - (M[3] * w + M[4] * h)) / 2;
+    scratch.resize(size_t(nh) * nw * 3);
+    warp_affine(pix.data(), h, w, M, scratch.data(), nh, nw, p.fill_value);
+    pix.swap(scratch);
+    h = nh;
+    w = nw;
+  }
+
+  // pad with fill_value (copyMakeBorder analogue)
+  if (p.pad > 0) {
+    int nh = h + 2 * p.pad, nw = w + 2 * p.pad;
+    scratch.assign(size_t(nh) * nw * 3, (unsigned char)p.fill_value);
+    for (int y = 0; y < h; ++y)
+      memcpy(scratch.data() + (size_t(y + p.pad) * nw + p.pad) * 3,
+             pix.data() + size_t(y) * w * 3, size_t(w) * 3);
+    pix.swap(scratch);
+    h = nh;
+    w = nw;
+  }
+
+  // crop: random crop-size square then resize, else data-shape window
+  if (p.max_crop_size != -1 || p.min_crop_size != -1) {
+    int lo = p.min_crop_size, hi = p.max_crop_size;
+    if (lo <= 0 || hi < lo) return false;  // frontend validates; be safe
+    if (h < hi || w < hi) return false;  // reference CHECKs the same
+    int cs = lo + int(rng() % uint64_t(hi - lo + 1));
+    int y0 = (h - cs) / 2, x0 = (w - cs) / 2;
+    if (p.rand_crop) {
+      y0 = int(rng() % uint64_t(h - cs + 1));
+      x0 = int(rng() % uint64_t(w - cs + 1));
+    }
+    std::vector<unsigned char> roi(size_t(cs) * cs * 3);
+    for (int y = 0; y < cs; ++y)
+      memcpy(roi.data() + size_t(y) * cs * 3,
+             pix.data() + (size_t(y0 + y) * w + x0) * 3, size_t(cs) * 3);
+    scratch.resize(size_t(p.out_h) * p.out_w * 3);
+    resize_bilinear(roi.data(), cs, cs, scratch.data(), p.out_h, p.out_w);
+    pix.swap(scratch);
+    h = p.out_h;
+    w = p.out_w;
+  } else if (h < p.out_h || w < p.out_w) {  // upscale to cover the window
     int nh = h > p.out_h ? h : p.out_h;
     int nw = w > p.out_w ? w : p.out_w;
     scratch.resize(size_t(nh) * nw * 3);
@@ -196,7 +381,6 @@ bool process_record(const unsigned char* rec, size_t len, const AugmentParams& p
     w = nw;
   }
 
-  std::mt19937_64 rng(seed);
   int y0, x0;
   if (p.rand_crop && (h > p.out_h || w > p.out_w)) {
     y0 = h > p.out_h ? int(rng() % uint64_t(h - p.out_h + 1)) : 0;
@@ -207,12 +391,31 @@ bool process_record(const unsigned char* rec, size_t len, const AugmentParams& p
   }
   bool mirror = p.rand_mirror && (rng() & 1u);
 
+  // HSL jitter deltas drawn once per image (image_aug_default.cc:299-320)
+  bool do_hsl = p.random_h || p.random_s || p.random_l;
+  float dh = 0, ds = 0, dl = 0;
+  if (do_hsl) {
+    dh = float(int(unif() * p.random_h * 2) - p.random_h);
+    ds = float(int(unif() * p.random_s * 2) - p.random_s);
+    dl = float(int(unif() * p.random_l * 2) - p.random_l);
+  }
+
   const size_t plane = size_t(p.out_h) * p.out_w;
   for (int y = 0; y < p.out_h; ++y) {
     for (int x = 0; x < p.out_w; ++x) {
       int sx = mirror ? (p.out_w - 1 - x) : x;
       const unsigned char* px =
           pix.data() + (size_t(y0 + y) * w + (x0 + sx)) * 3;
+      unsigned char jittered[3];
+      if (do_hsl) {
+        float hls[3];
+        rgb_to_hls(px, hls);
+        hls[0] = std::min(std::max(hls[0] + dh, 0.f), 180.f);
+        hls[1] = std::min(std::max(hls[1] + dl, 0.f), 255.f);
+        hls[2] = std::min(std::max(hls[2] + ds, 0.f), 255.f);
+        hls_to_rgb(hls, jittered);
+        px = jittered;
+      }
       for (int c = 0; c < 3; ++c) {
         out[size_t(c) * plane + size_t(y) * p.out_w + x] =
             (float(px[c]) - p.mean[c]) / p.std[c] * p.scale;
@@ -268,11 +471,14 @@ int64_t mxio_scan(const char* path, int64_t* offsets, int64_t cap) {
 // Load + decode + augment a batch. data_out: (n, 3, out_h, out_w) float32;
 // label_out: (n, label_width) float32. Returns number of records decoded
 // successfully (failed decodes leave zero-filled slots), or -1 on IO error.
-int64_t mxio_load_batch(const char* path, const int64_t* offsets, int64_t n,
-                        int out_h, int out_w, int resize_short, int rand_crop,
-                        int rand_mirror, const float* mean, const float* stdv,
-                        float scale, int label_width, uint64_t seed,
-                        int num_threads, float* data_out, float* label_out) {
+// ``extra`` (nullable) carries the DefaultImageAugmentParam extension as a
+// flat float array in the order documented in native/__init__.py.
+int64_t mxio_load_batch2(const char* path, const int64_t* offsets, int64_t n,
+                         int out_h, int out_w, int resize_short,
+                         int rand_crop, int rand_mirror, const float* mean,
+                         const float* stdv, float scale, int label_width,
+                         uint64_t seed, int num_threads, const float* extra,
+                         float* data_out, float* label_out) {
   // Stage 1 (serial): byte reads — one file handle, sequential seeks.
   std::vector<Bytes> raw(n);
   {
@@ -298,6 +504,23 @@ int64_t mxio_load_batch(const char* path, const int64_t* offsets, int64_t n,
   memcpy(p.std, stdv, sizeof p.std);
   p.scale = scale;
   p.label_width = label_width;
+  if (extra) {
+    p.max_rotate_angle = int(extra[0]);
+    p.rotate = int(extra[1]);
+    p.max_shear_ratio = extra[2];
+    p.max_random_scale = extra[3];
+    p.min_random_scale = extra[4];
+    p.max_aspect_ratio = extra[5];
+    p.min_img_size = extra[6];
+    p.max_img_size = extra[7];
+    p.max_crop_size = int(extra[8]);
+    p.min_crop_size = int(extra[9]);
+    p.random_h = int(extra[10]);
+    p.random_s = int(extra[11]);
+    p.random_l = int(extra[12]);
+    p.pad = int(extra[13]);
+    p.fill_value = int(extra[14]);
+  }
 
   const size_t img_elems = size_t(3) * out_h * out_w;
   memset(data_out, 0, sizeof(float) * img_elems * n);
@@ -324,6 +547,18 @@ int64_t mxio_load_batch(const char* path, const int64_t* offsets, int64_t n,
   }
   for (auto& th : pool) th.join();
   return ok.load();
+}
+
+// original entry kept for ABI compatibility: no extension params
+int64_t mxio_load_batch(const char* path, const int64_t* offsets, int64_t n,
+                        int out_h, int out_w, int resize_short, int rand_crop,
+                        int rand_mirror, const float* mean, const float* stdv,
+                        float scale, int label_width, uint64_t seed,
+                        int num_threads, float* data_out, float* label_out) {
+  return mxio_load_batch2(path, offsets, n, out_h, out_w, resize_short,
+                          rand_crop, rand_mirror, mean, stdv, scale,
+                          label_width, seed, num_threads, nullptr, data_out,
+                          label_out);
 }
 
 }  // extern "C"

@@ -233,6 +233,11 @@ class ImageRecordIter:
                  resize=-1, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
                  max_random_scale=1.0, min_random_scale=1.0,
+                 max_rotate_angle=0, rotate=-1, max_shear_ratio=0.0,
+                 max_aspect_ratio=0.0, max_crop_size=-1, min_crop_size=-1,
+                 min_img_size=0.0, max_img_size=1e10,
+                 random_h=0, random_s=0, random_l=0, pad=0, fill_value=255,
+                 inter_method=1,
                  part_index=0, num_parts=1, preprocess_threads=None,
                  round_batch=True, seed=0, data_name="data",
                  label_name="softmax_label", path_imgidx=None,
@@ -248,6 +253,33 @@ class ImageRecordIter:
         self.mean = np.array([mean_r, mean_g, mean_b], dtype=np.float32)
         self.std = np.array([std_r, std_g, std_b], dtype=np.float32)
         self.scale = scale
+        # DefaultImageAugmentParam set (image_aug_default.cc:25-128), the
+        # reference's names and defaults
+        self.aug = dict(
+            max_rotate_angle=max_rotate_angle, rotate=rotate,
+            max_shear_ratio=max_shear_ratio,
+            max_random_scale=max_random_scale,
+            min_random_scale=min_random_scale,
+            max_aspect_ratio=max_aspect_ratio,
+            max_crop_size=max_crop_size, min_crop_size=min_crop_size,
+            min_img_size=min_img_size, max_img_size=max_img_size,
+            random_h=random_h, random_s=random_s, random_l=random_l,
+            pad=pad, fill_value=fill_value, inter_method=inter_method,
+        )
+        self._needs_affine = (
+            max_rotate_angle > 0 or rotate > 0 or max_shear_ratio > 0
+            or max_random_scale != 1.0 or min_random_scale != 1.0
+            or max_aspect_ratio != 0.0 or min_img_size != 0.0
+            or max_img_size != 1e10
+        )
+        if (max_crop_size != -1) != (min_crop_size != -1):
+            raise MXNetError(
+                "max_crop_size and min_crop_size must be set together "
+                f"(got max={max_crop_size}, min={min_crop_size})")
+        if max_crop_size != -1 and not (0 < min_crop_size <= max_crop_size):
+            raise MXNetError(
+                f"need 0 < min_crop_size ({min_crop_size}) <= "
+                f"max_crop_size ({max_crop_size})")
         self.data_name = data_name
         self.label_name = label_name
         self.rs = np.random.RandomState(seed)
@@ -358,19 +390,61 @@ class ImageRecordIter:
             short = min(img.shape[:2])
             s = self.resize / short
             img = cv2.resize(img, (int(round(img.shape[1] * s)), int(round(img.shape[0] * s))))
-        ih, iw = img.shape[:2]
-        if self.rand_crop and (ih > h or iw > w):
-            # per-axis bounds: one dimension may already be <= target
-            y = rs.randint(0, max(ih - h, 0) + 1)
-            x = rs.randint(0, max(iw - w, 0) + 1)
+        aug = self.aug
+        if self._needs_affine:
+            from .image import affine_matrix, apply_affine
+
+            M, nw, nh = affine_matrix(
+                rs, img.shape[0], img.shape[1],
+                aug["max_rotate_angle"], aug["rotate"],
+                aug["max_shear_ratio"], aug["max_random_scale"],
+                aug["min_random_scale"], aug["max_aspect_ratio"],
+                aug["min_img_size"], aug["max_img_size"])
+            img = apply_affine(img, M, nw, nh, aug["fill_value"],
+                               aug["inter_method"]
+                               if aug["inter_method"] in (0, 1, 2, 3, 4)
+                               else 1)
+        if aug["pad"] > 0:
+            p = aug["pad"]
+            fv = aug["fill_value"]
+            img = cv2.copyMakeBorder(img, p, p, p, p, cv2.BORDER_CONSTANT,
+                                     value=(fv, fv, fv))
+        if aug["max_crop_size"] != -1 or aug["min_crop_size"] != -1:
+            # random square crop in [min_crop_size, max_crop_size], then
+            # resize to data_shape (image_aug_default.cc:261-280)
+            cs = rs.randint(aug["min_crop_size"], aug["max_crop_size"] + 1)
+            ih, iw = img.shape[:2]
+            if ih < cs or iw < cs:
+                raise MXNetError(
+                    f"input image ({ih}x{iw}) smaller than max_crop_size "
+                    f"{aug['max_crop_size']}")
+            if self.rand_crop:
+                y = rs.randint(0, ih - cs + 1)
+                x = rs.randint(0, iw - cs + 1)
+            else:
+                y, x = (ih - cs) // 2, (iw - cs) // 2
+            img = cv2.resize(img[y:y + cs, x:x + cs], (w, h))
         else:
-            y = max((ih - h) // 2, 0)
-            x = max((iw - w) // 2, 0)
-        if ih < h or iw < w:
-            img = cv2.resize(img, (max(w, iw), max(h, ih)))
-        img = img[y:y + h, x:x + w]
+            ih, iw = img.shape[:2]
+            if ih < h or iw < w:
+                img = cv2.resize(img, (max(w, iw), max(h, ih)))
+                ih, iw = img.shape[:2]
+            if self.rand_crop and (ih > h or iw > w):
+                # per-axis bounds: one dimension may already be <= target
+                y = rs.randint(0, max(ih - h, 0) + 1)
+                x = rs.randint(0, max(iw - w, 0) + 1)
+            else:
+                y = max((ih - h) // 2, 0)
+                x = max((iw - w) // 2, 0)
+            img = img[y:y + h, x:x + w]
         if self.rand_mirror and rs.rand() < 0.5:
             img = img[:, ::-1]
+        if aug["random_h"] or aug["random_s"] or aug["random_l"]:
+            from .image import apply_hsl
+
+            img = apply_hsl(np.ascontiguousarray(img, np.uint8), rs,
+                            aug["random_h"], aug["random_s"],
+                            aug["random_l"])
         arr = img.astype(np.float32)
         arr = (arr - self.mean) / self.std * self.scale
         arr = arr.transpose(2, 0, 1)  # HWC → CHW (reference layout)
@@ -414,6 +488,7 @@ class ImageRecordIter:
         from .io import DataBatch
         from .ndarray import array
 
+        extra = {k: v for k, v in self.aug.items() if k != "inter_method"}
         data, labels, ok = _native.load_batch(
             self.path_imgrec,
             np.asarray(self._offsets, np.int64)[idxs],
@@ -425,6 +500,7 @@ class ImageRecordIter:
             label_width=self.label_width,
             seed=int(self.rs.randint(0, 2 ** 31 - 1)),
             num_threads=self._threads,
+            **extra,
         )
         if ok < len(idxs):
             # undecodable records would otherwise train as all-zero images

@@ -15,6 +15,7 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import image as mx_image
+from mxnet_tpu import recordio
 from mxnet_tpu.recordio import MXIndexedRecordIO, MXRecordIO, pack_img, unpack_img
 from mxnet_tpu.test_utils import assert_almost_equal
 
@@ -190,3 +191,223 @@ def test_record_iter_throughput(tmp_path):
     rate = n / (time.time() - tic)
     print(f"\nImageRecordIter decode+augment: {rate:.0f} img/s (64px)")
     assert rate > 50  # sanity floor, not a perf target
+
+
+# ---------------------------------------------------------------------------
+# DefaultImageAugmentParam parity (reference image_aug_default.cc:25-188)
+# ---------------------------------------------------------------------------
+_REF_AUG_PARAMS = [
+    # every DMLC_DECLARE_FIELD of DefaultImageAugmentParam except data_shape
+    "resize", "rand_crop", "max_rotate_angle", "max_aspect_ratio",
+    "max_shear_ratio", "max_crop_size", "min_crop_size", "max_random_scale",
+    "min_random_scale", "max_img_size", "min_img_size", "random_h",
+    "random_s", "random_l", "rotate", "fill_value", "inter_method", "pad",
+]
+
+
+def _solid_rec(path, color, n=4, size=60):
+    # NB: pack_img encodes via cv2 (BGR), the iterator emits RGB — callers
+    # compare against color[::-1]
+    rec = recordio.MXRecordIO(path, "w")
+    img = np.full((size, size, 3), color, np.uint8)
+    for i in range(n):
+        rec.write(recordio.pack_img((0, float(i), i, 0), img, quality=98))
+    rec.close()
+    return img
+
+
+def test_augment_param_parity_with_reference():
+    """Both IO planes accept every DefaultImageAugmentParam name."""
+    import inspect
+
+    sig = inspect.signature(recordio.ImageRecordIter.__init__)
+    for p in _REF_AUG_PARAMS:
+        assert p in sig.parameters, f"ImageRecordIter missing {p!r}"
+    from mxnet_tpu import image as img_mod
+
+    csig = inspect.signature(img_mod.CreateAugmenter)
+    for p in ("max_rotate_angle", "rotate", "max_shear_ratio",
+              "max_random_scale", "min_random_scale", "max_aspect_ratio",
+              "min_random_area", "max_random_area", "random_h", "random_s",
+              "random_l", "pad", "fill_value"):
+        assert p in csig.parameters, f"CreateAugmenter missing {p!r}"
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_rotation_and_fill(tmp_path, use_native):
+    """rotate=45 on a solid image keeps the center color and fills the
+    corners with fill_value (the warp's constant border)."""
+    from mxnet_tpu import native
+
+    if use_native and not native.available():
+        pytest.skip("native plane unavailable")
+    rec = str(tmp_path / f"rot{int(use_native)}.rec")
+    _solid_rec(rec, (200, 60, 20), size=60)
+    it = recordio.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 60, 60), batch_size=4,
+        rotate=45, fill_value=0, use_native=use_native)
+    batch = next(iter(it))
+    d = batch.data[0].asnumpy()
+    # center pixel keeps the color; the exact corner is filled
+    assert np.allclose(d[0, :, 30, 30], [20, 60, 200], atol=12)
+    assert np.allclose(d[0, :, 1, 1], [0, 0, 0], atol=6)
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_random_scale_bounds(tmp_path, use_native):
+    """min/max_random_scale up-scales before the crop: a 60px solid image
+    scaled by exactly 2 then center-cropped to 100 has NO border fill."""
+    from mxnet_tpu import native
+
+    if use_native and not native.available():
+        pytest.skip("native plane unavailable")
+    rec = str(tmp_path / f"sc{int(use_native)}.rec")
+    _solid_rec(rec, (10, 180, 90), size=60)
+    it = recordio.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 100, 100), batch_size=4,
+        max_random_scale=2.0, min_random_scale=2.0, fill_value=255,
+        use_native=use_native)
+    d = next(iter(it)).data[0].asnumpy()
+    assert np.allclose(d[0, :, 50, 50], [90, 180, 10], atol=8)
+    assert np.allclose(d[0, :, 2, 2], [90, 180, 10], atol=8)
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_shear_moves_mass_sideways(tmp_path, use_native):
+    """max_shear_ratio warps a vertical stripe: rows stay aligned but
+    columns shift with y, so some off-stripe columns gain stripe color."""
+    from mxnet_tpu import native
+
+    if use_native and not native.available():
+        pytest.skip("native plane unavailable")
+    rec = str(tmp_path / f"sh{int(use_native)}.rec")
+    img = np.zeros((64, 64, 3), np.uint8)
+    img[:, 28:36] = (255, 255, 255)  # vertical stripe
+    r = recordio.MXRecordIO(rec, "w")
+    for i in range(8):
+        r.write(recordio.pack_img((0, float(i), i, 0), img, quality=98))
+    r.close()
+    it = recordio.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 64, 64), batch_size=8,
+        max_shear_ratio=0.3, fill_value=0, use_native=use_native, seed=3)
+    d = next(iter(it)).data[0].asnumpy()
+    # with |shear| up to 0.3 some sample must displace the stripe between
+    # top and bottom rows by several pixels
+    disp = []
+    for b in range(8):
+        top = d[b, 0, 2, :]
+        bot = d[b, 0, 61, :]
+        if top.max() > 100 and bot.max() > 100:
+            disp.append(abs(int(np.argmax(top)) - int(np.argmax(bot))))
+    assert disp and max(disp) > 4, disp
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_hsl_lightness_jitter(tmp_path, use_native):
+    """random_l shifts mean brightness while random_h/s=0 keeps hue; with
+    the jitter span at 100 the per-image means must spread."""
+    from mxnet_tpu import native
+
+    if use_native and not native.available():
+        pytest.skip("native plane unavailable")
+    rec = str(tmp_path / f"hsl{int(use_native)}.rec")
+    _solid_rec(rec, (120, 120, 120), n=8, size=40)
+    it = recordio.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 40, 40), batch_size=8,
+        random_l=100, use_native=use_native, seed=5)
+    d = next(iter(it)).data[0].asnumpy()
+    means = d.mean(axis=(1, 2, 3))
+    assert means.std() > 10, means  # jitter actually applied per image
+    # grey input stays grey: channels move together
+    assert np.abs(d[:, 0] - d[:, 1]).max() < 8
+    assert np.abs(d[:, 1] - d[:, 2]).max() < 8
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_crop_size_window_and_pad(tmp_path, use_native):
+    from mxnet_tpu import native
+
+    if use_native and not native.available():
+        pytest.skip("native plane unavailable")
+    rec = str(tmp_path / f"cw{int(use_native)}.rec")
+    _solid_rec(rec, (50, 100, 150), n=4, size=56)
+    it = recordio.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 32, 32), batch_size=4,
+        rand_crop=True, max_crop_size=48, min_crop_size=24,
+        use_native=use_native)
+    d = next(iter(it)).data[0].asnumpy()
+    assert d.shape == (4, 3, 32, 32)
+    assert np.allclose(d[0, :, 16, 16], [150, 100, 50], atol=8)
+
+    it2 = recordio.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 72, 72), batch_size=4,
+        pad=8, fill_value=7, use_native=use_native)
+    d2 = next(iter(it2)).data[0].asnumpy()
+    # 56 + 2*8 = 72: the pad border survives the center crop exactly
+    assert np.allclose(d2[0, :, 0, 0], [7, 7, 7], atol=4)
+    assert np.allclose(d2[0, :, 36, 36], [150, 100, 50], atol=8)
+
+
+def test_rand_resized_crop_area_window(tmp_path):
+    """image.py rand_resize honors the min/max_random_area window."""
+    from mxnet_tpu import image as img_mod
+
+    rs = np.random.RandomState(0)
+    src = img_mod.array(rs.randint(0, 255, (64, 64, 3), np.uint8))
+    out, (x0, y0, w, h) = img_mod.random_size_crop(
+        src, (32, 32), (0.5, 0.6), (0.9, 1.1))
+    area_frac = (w * h) / (64.0 * 64.0)
+    assert 0.4 <= area_frac <= 0.7, area_frac
+    assert out.shape[:2] == (32, 32)
+
+
+def test_native_keeps_throughput_edge_with_new_augmenters(tmp_path):
+    """The native plane must stay at least as fast as the python plane
+    with the full augmenter set on (rotation + shear + scale + HSL)."""
+    import time
+
+    from mxnet_tpu import native
+
+    if not native.available():
+        pytest.skip("native plane unavailable")
+    rec_path = str(tmp_path / "tp2.rec")
+    rec = MXRecordIO(rec_path, "w")
+    rng = np.random.RandomState(3)
+    for i in range(128):
+        rec.write(pack_img((0, 0.0, i, 0),
+                           rng.randint(0, 255, (96, 96, 3), np.uint8)))
+    rec.close()
+    aug = dict(rand_crop=True, rand_mirror=True, max_rotate_angle=15,
+               max_shear_ratio=0.1, max_random_scale=1.2,
+               min_random_scale=0.9, random_h=10, random_s=20, random_l=20,
+               preprocess_threads=4)
+
+    def rate(use_native):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=rec_path, data_shape=(3, 80, 80), batch_size=32,
+            use_native=use_native, **aug)
+        list(it)  # warm pools/caches
+        it.reset()
+        tic = time.time()
+        n = sum(b.data[0].shape[0] for b in it)
+        return n / (time.time() - tic)
+
+    r_native = max(rate(True) for _ in range(2))
+    r_python = max(rate(False) for _ in range(2))
+    print(f"\nfull-augmenter throughput: native {r_native:.0f} img/s vs "
+          f"python {r_python:.0f} img/s")
+    assert r_native > 0.8 * r_python, (r_native, r_python)
+
+
+def test_crop_size_window_validation(tmp_path):
+    rec = str(tmp_path / "val.rec")
+    _solid_rec(rec, (9, 9, 9), n=2, size=40)
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="set together"):
+        recordio.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                                 batch_size=2, min_crop_size=24)
+    with pytest.raises(MXNetError, match="min_crop_size"):
+        recordio.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                                 batch_size=2, min_crop_size=48,
+                                 max_crop_size=24)
