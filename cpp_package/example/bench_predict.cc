@@ -1,0 +1,71 @@
+// Deployment-path benchmark client: time MXPred* inference over the
+// amalgamated library (the reference's amalgamation exists precisely for
+// this deployment story). Prints one line per run:
+//   C <batch> <img_per_sec>
+// Usage: bench_predict <symbol.json> <params> <batch> <iters> [dev_type]
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include "mxtpu.h"
+
+static std::string slurp(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    fprintf(stderr, "cannot open %s\n", path);
+    exit(1);
+  }
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string s(n, '\0');
+  if (fread(&s[0], 1, n, f) != size_t(n)) exit(1);
+  fclose(f);
+  return s;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s sym.json params batch iters [dev_type]\n",
+            argv[0]);
+    return 2;
+  }
+  std::string sym = slurp(argv[1]);
+  std::string params = slurp(argv[2]);
+  int batch = atoi(argv[3]);
+  int iters = atoi(argv[4]);
+  int dev_type = argc > 5 ? atoi(argv[5]) : 2;
+
+  const char* keys[] = {"data"};
+  uint32_t indptr[] = {0, 4};
+  uint32_t dims[] = {uint32_t(batch), 3, 224, 224};
+  PredictorHandle pred = nullptr;
+  if (MXPredCreate(sym.c_str(), params.data(), int(params.size()), dev_type,
+                   0, 1, keys, indptr, dims, &pred) != 0) {
+    fprintf(stderr, "MXPredCreate: %s\n", MXGetLastError());
+    return 1;
+  }
+  size_t in_elems = size_t(batch) * 3 * 224 * 224;
+  std::vector<float> input(in_elems);
+  for (size_t i = 0; i < in_elems; ++i) input[i] = float(i % 255) / 255.f;
+  std::vector<float> output(size_t(batch) * 1000);
+
+  auto once = [&]() {
+    if (MXPredSetInput(pred, "data", input.data(), uint32_t(in_elems)) ||
+        MXPredForward(pred) ||
+        MXPredGetOutput(pred, 0, output.data(), uint32_t(output.size()))) {
+      fprintf(stderr, "predict: %s\n", MXGetLastError());
+      exit(1);
+    }
+  };
+  for (int i = 0; i < 3; ++i) once();  // warmup/compile
+  auto tic = std::chrono::steady_clock::now();
+  for (int i = 0; i < iters; ++i) once();
+  double dt = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - tic).count();
+  printf("C %d %.2f\n", batch, batch * iters / dt);
+  MXPredFree(pred);
+  return 0;
+}

@@ -178,6 +178,19 @@ def ColorNormalizeAug(mean, std):
 # augmenter list and the python ImageRecordIter plane; the native plane
 # (native/io_plane.cpp) replicates the same math in C++.
 # ---------------------------------------------------------------------------
+def needs_affine(max_rotate_angle=0, rotate=-1, max_shear_ratio=0.0,
+                 max_random_scale=1.0, min_random_scale=1.0,
+                 max_aspect_ratio=0.0, min_img_size=0.0, max_img_size=1e10,
+                 **_ignored):
+    """Whether any affine-block parameter departs from its default — the
+    single source of truth for both python planes (the C++ twin is
+    AugmentParams::needs_affine in native/io_plane.cpp)."""
+    return (max_rotate_angle > 0 or rotate > 0 or max_shear_ratio > 0
+            or max_random_scale != 1.0 or min_random_scale != 1.0
+            or max_aspect_ratio != 0.0 or min_img_size != 0.0
+            or max_img_size != 1e10)
+
+
 def affine_matrix(rs, h, w, max_rotate_angle=0, rotate=-1,
                   max_shear_ratio=0.0, max_random_scale=1.0,
                   min_random_scale=1.0, max_aspect_ratio=0.0,
@@ -294,10 +307,9 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
-    if (max_rotate_angle > 0 or rotate > 0 or max_shear_ratio > 0
-            or max_random_scale != 1.0 or min_random_scale != 1.0
-            or max_aspect_ratio != 0.0 or min_img_size != 0.0
-            or max_img_size != 1e10):
+    if needs_affine(max_rotate_angle, rotate, max_shear_ratio,
+                    max_random_scale, min_random_scale, max_aspect_ratio,
+                    min_img_size, max_img_size):
         auglist.append(DefaultAffineAug(
             max_rotate_angle, rotate, max_shear_ratio, max_random_scale,
             min_random_scale, max_aspect_ratio, min_img_size, max_img_size,

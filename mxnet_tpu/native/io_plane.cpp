@@ -324,7 +324,9 @@ bool process_record(const unsigned char* rec, size_t len, const AugmentParams& p
     float ws = ratio * hs;
     float nwf = std::max(p.min_img_size, std::min(p.max_img_size, sc * w));
     float nhf = std::max(p.min_img_size, std::min(p.max_img_size, sc * h));
-    int nw = int(nwf), nh = int(nhf);
+    // a tiny image x small min_random_scale can truncate to 0 (the default
+    // min_img_size=0 does not guard); an empty warp target is UB downstream
+    int nw = std::max(1, int(nwf)), nh = std::max(1, int(nhf));
     float M[6];
     M[0] = hs * ca - shear * sb * ws;
     M[1] = hs * sb + shear * ca * ws;

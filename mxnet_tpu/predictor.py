@@ -86,7 +86,10 @@ class Predictor:
                         f"param {name} shape mismatch: bound {shape}, "
                         f"file {self.arg_params[name].shape}"
                     )
-                args[name] = self.arg_params[name]
+                # params must live ON the inference device: host-resident
+                # arrays (the nd_load default) would re-transfer on every
+                # forward — ~100 ms/call of weight upload for ResNet-50
+                args[name] = self.arg_params[name].as_in_context(self.ctx)
             else:
                 # reference c_predict_api leaves args absent from the param
                 # file zero-initialised (labels etc., c_predict_api.cc:195)
@@ -94,7 +97,7 @@ class Predictor:
         auxs = {}
         for name, shape in zip(aux_names, aux_shapes):
             if name in self.aux_params:
-                auxs[name] = self.aux_params[name]
+                auxs[name] = self.aux_params[name].as_in_context(self.ctx)
             else:
                 auxs[name] = zeros(shape, ctx=self.ctx)
         self._exec = Executor(

@@ -266,12 +266,9 @@ class ImageRecordIter:
             random_h=random_h, random_s=random_s, random_l=random_l,
             pad=pad, fill_value=fill_value, inter_method=inter_method,
         )
-        self._needs_affine = (
-            max_rotate_angle > 0 or rotate > 0 or max_shear_ratio > 0
-            or max_random_scale != 1.0 or min_random_scale != 1.0
-            or max_aspect_ratio != 0.0 or min_img_size != 0.0
-            or max_img_size != 1e10
-        )
+        from .image import needs_affine
+
+        self._needs_affine = needs_affine(**self.aug)
         if (max_crop_size != -1) != (min_crop_size != -1):
             raise MXNetError(
                 "max_crop_size and min_crop_size must be set together "
@@ -411,13 +408,15 @@ class ImageRecordIter:
                                      value=(fv, fv, fv))
         if aug["max_crop_size"] != -1 or aug["min_crop_size"] != -1:
             # random square crop in [min_crop_size, max_crop_size], then
-            # resize to data_shape (image_aug_default.cc:261-280)
-            cs = rs.randint(aug["min_crop_size"], aug["max_crop_size"] + 1)
+            # resize to data_shape (image_aug_default.cc:261-280). The
+            # bound is checked against max_crop_size — deterministic per
+            # image, like the reference's CHECK — never against the draw
             ih, iw = img.shape[:2]
-            if ih < cs or iw < cs:
+            if ih < aug["max_crop_size"] or iw < aug["max_crop_size"]:
                 raise MXNetError(
                     f"input image ({ih}x{iw}) smaller than max_crop_size "
                     f"{aug['max_crop_size']}")
+            cs = rs.randint(aug["min_crop_size"], aug["max_crop_size"] + 1)
             if self.rand_crop:
                 y = rs.randint(0, ih - cs + 1)
                 x = rs.randint(0, iw - cs + 1)
@@ -503,11 +502,13 @@ class ImageRecordIter:
             **extra,
         )
         if ok < len(idxs):
-            # undecodable records would otherwise train as all-zero images
+            # rejected records would otherwise train as all-zero images
             raise MXNetError(
-                f"{self.path_imgrec}: {len(idxs) - ok} record(s) failed to "
-                "decode on the native plane (libjpeg handles JPEG only); "
-                "pass use_native=False for other image formats"
+                f"{self.path_imgrec}: {len(idxs) - ok} record(s) rejected "
+                "by the native plane — not a decodable JPEG (libjpeg "
+                "handles JPEG only; pass use_native=False for other "
+                "formats) or the image violates the augmentation contract "
+                "(smaller than max_crop_size)"
             )
         label = labels[:, 0] if self.label_width == 1 else labels
         return DataBatch(

@@ -411,3 +411,25 @@ def test_crop_size_window_validation(tmp_path):
         recordio.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
                                  batch_size=2, min_crop_size=48,
                                  max_crop_size=24)
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_crop_size_check_is_deterministic(tmp_path, use_native):
+    """An image smaller than max_crop_size must fail on the FIRST batch
+    with a size error — never nondeterministically on an unlucky draw,
+    and never disguised as a decode failure."""
+    from mxnet_tpu import native
+    from mxnet_tpu.base import MXNetError
+
+    if use_native and not native.available():
+        pytest.skip("native plane unavailable")
+    rec = str(tmp_path / f"small{int(use_native)}.rec")
+    _solid_rec(rec, (5, 5, 5), n=4, size=40)  # 40px < max_crop_size=48
+    it = recordio.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 32, 32), batch_size=4,
+        rand_crop=True, min_crop_size=24, max_crop_size=48,
+        use_native=use_native, seed=0)
+    for _ in range(5):  # every epoch fails, first batch, same error
+        with pytest.raises(MXNetError, match="max_crop_size"):
+            next(iter(it))
+        it.reset()

@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""accnn — low-rank model compression over the Symbol API.
+
+Reference: ``tools/accnn`` (accnn.py driver + acc_conv.py / acc_fc.py /
+rank_selection.py). A KxK Convolution factorizes into a vertical (Kx1)
+conv with R filters followed by a horizontal (1xK) conv (the Jaderberg
+scheme, exactly the reference's SVD split: W[(c,y),(n,x)] = U S V^T with
+V-conv U*sqrt(S) and H-conv sqrt(S)*V^T); a FullyConnected factorizes
+into two FCs through an R-dim bottleneck. Rank selection mirrors the
+reference's energy-based allocation with a simpler search: a global
+retained-energy threshold, binary-searched so the factorized FLOPs hit
+the requested speedup (the reference solves the same trade-off with a
+knapsack DP over log-energies).
+
+After compression, fine-tune: load the returned (symbol, arg_params)
+into a Module and fit a few epochs — the reference README's recipe.
+
+Usage:
+    python tools/accnn.py --model prefix --epoch 0 --speedup 2 \\
+        --data-shape 3,224,224 --save-model prefix-acc
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _conv_svd(W):
+    """Singular values of the (C*KH, N*KW) matricization."""
+    N, C, kh, kw = W.shape
+    M = W.transpose(1, 2, 0, 3).reshape(C * kh, N * kw)
+    return np.linalg.svd(M, compute_uv=False)
+
+
+def _split_conv_weights(W, rank):
+    N, C, kh, kw = W.shape
+    M = W.transpose(1, 2, 0, 3).reshape(C * kh, N * kw)
+    U, D, Qt = np.linalg.svd(M, full_matrices=False)
+    sq = np.sqrt(D[:rank])
+    V = (U[:, :rank] * sq).T.reshape(rank, C, kh, 1)
+    H = (Qt[:rank].T * sq).reshape(N, kw, 1, rank).transpose(0, 3, 2, 1)
+    return V.astype(W.dtype), H.astype(W.dtype)
+
+
+def _split_fc_weights(W, rank):
+    N, M_ = W.shape
+    U, D, Qt = np.linalg.svd(W, full_matrices=False)
+    sq = np.sqrt(D[:rank])
+    W1 = (Qt[:rank].T * sq).T          # (rank, M)
+    W2 = U[:, :rank] * sq              # (N, rank)
+    return W1.astype(W.dtype), W2.astype(W.dtype)
+
+
+def _conv_flops(node_params, in_c, out_hw, rank=None):
+    kh, kw = node_params["kernel"]
+    n = node_params["num_filter"]
+    h, w = out_hw
+    if rank is None:
+        return kh * kw * in_c * n * h * w
+    # V: kh*1 over C -> rank, H: 1*kw over rank -> n
+    return kh * in_c * rank * h * w + kw * rank * n * h * w
+
+
+class _Plan:
+    __slots__ = ("node", "kind", "svals", "flops_fn", "rank")
+
+    def __init__(self, node, kind, svals, flops_fn):
+        self.node = node
+        self.kind = kind
+        self.svals = svals
+        self.flops_fn = flops_fn  # rank|None -> flops
+        self.rank = None
+
+
+def factorize(symbol, arg_params, speedup=2.0, data_shape=(3, 224, 224),
+              min_rank=4, skip=()):
+    """Compress (symbol, arg_params): returns (new_symbol, new_arg_params,
+    report) with report = {layer: (rank, max_rank, kept_energy)}.
+
+    Only stride-compatible KxK convs with K>1 and FullyConnected layers
+    factorize; 1x1 convs and layers in ``skip`` pass through."""
+    from mxnet_tpu.ops import registry
+    from mxnet_tpu.symbol import Symbol, _Node, fromjson
+
+    sym = fromjson(symbol.tojson())
+    arg_params = dict(arg_params)
+
+    # internal output shapes for FLOPs accounting
+    internals = sym.get_internals()
+    _, out_shapes, _ = internals.infer_shape(data=(1,) + tuple(data_shape))
+    shape_of = dict(zip(internals.list_outputs(), out_shapes))
+
+    plans = []
+    for node in sym._topo():
+        if node.is_variable or node.name in skip:
+            continue
+        params = node.params()
+        wname = f"{node.name}_weight"
+        if wname not in arg_params:
+            continue
+        W = np.asarray(arg_params[wname].asnumpy())
+        if node.op.name == "Convolution":
+            kh, kw = params["kernel"]
+            if kh <= 1 or kw <= 1 or params.get("num_group", 1) != 1:
+                continue
+            out_shape = shape_of.get(f"{node.name}_output")
+            if out_shape is None or len(out_shape) != 4:
+                continue
+            in_c, out_hw = W.shape[1], out_shape[2:]
+            p = dict(kernel=(kh, kw), num_filter=params["num_filter"])
+            plans.append(_Plan(
+                node, "conv", _conv_svd(W),
+                lambda r, p=p, c=in_c, o=out_hw: _conv_flops(p, c, o, r)))
+        elif node.op.name == "FullyConnected":
+            n, m = W.shape
+            plans.append(_Plan(
+                node, "fc", np.linalg.svd(W, compute_uv=False),
+                lambda r, n=n, m=m: n * m if r is None else r * (n + m)))
+    if not plans:
+        return sym, arg_params, {}
+
+    base_flops = sum(p.flops_fn(None) for p in plans)
+    budget = base_flops / float(speedup)
+
+    def ranks_at(tau):
+        """Per-layer minimal rank keeping >= tau of the energy."""
+        out = []
+        for p in plans:
+            e = np.cumsum(p.svals ** 2)
+            e /= e[-1]
+            r = int(np.searchsorted(e, tau) + 1)
+            out.append(max(min_rank, min(r, len(p.svals))))
+        return out
+
+    lo, hi = 0.0, 1.0
+    for _ in range(40):  # binary search the energy threshold to the budget
+        mid = (lo + hi) / 2
+        cost = sum(p.flops_fn(r) for p, r in zip(plans, ranks_at(mid)))
+        if cost > budget:
+            hi = mid
+        else:
+            lo = mid
+    ranks = ranks_at(lo)
+
+    convdef = registry.get("Convolution")
+    fcdef = registry.get("FullyConnected")
+    replaced = {}
+    new_nodes = []
+    report = {}
+    for p, rank in zip(plans, ranks):
+        node = p.node
+        name = node.name
+        params = node.params()
+        W = np.asarray(arg_params.pop(f"{name}_weight").asnumpy())
+        data_in = node.inputs[0]
+        bias_in = None
+        if not params.get("no_bias", False):
+            bias_in = node.inputs[len(node.op.arg_names(params)) - 1]
+        e = np.cumsum(p.svals ** 2)
+        kept = float(e[rank - 1] / e[-1])
+        report[name] = (rank, len(p.svals), kept)
+        if rank >= len(p.svals):
+            # full rank: splitting would only add FLOPs; keep the layer
+            arg_params[f"{name}_weight"] = _nd(W)
+            report[name] = (rank, len(p.svals), 1.0)
+            continue
+        if p.kind == "conv":
+            V, H = _split_conv_weights(W, rank)
+            kh, kw = params["kernel"]
+            sh, sw = params.get("stride") or (1, 1)
+            ph, pw = params.get("pad") or (0, 0)
+            v_attrs = {
+                "kernel": f"({kh}, 1)", "stride": f"({sh}, 1)",
+                "pad": f"({ph}, 0)", "num_filter": str(rank),
+                "no_bias": "True",
+            }
+            v_w = _Node(None, f"{name}_v_weight")
+            v_node = _Node(convdef, f"{name}_v", v_attrs,
+                           [data_in, (v_w, 0)])
+            h_attrs = {
+                "kernel": f"(1, {kw})", "stride": f"(1, {sw})",
+                "pad": f"(0, {pw})",
+                "num_filter": str(params["num_filter"]),
+                "no_bias": str(bool(params.get("no_bias", False))),
+            }
+            h_w = _Node(None, f"{name}_h_weight")
+            h_inputs = [(v_node, 0), (h_w, 0)]
+            if bias_in is not None:
+                h_inputs.append(bias_in)
+            h_node = _Node(convdef, f"{name}_h", h_attrs, h_inputs)
+            arg_params[f"{name}_v_weight"] = _nd(V)
+            arg_params[f"{name}_h_weight"] = _nd(H)
+            replaced[id(node)] = h_node
+            new_nodes.append(v_node)
+        else:
+            W1, W2 = _split_fc_weights(W, rank)
+            f1_attrs = {"num_hidden": str(rank), "no_bias": "True"}
+            f1_w = _Node(None, f"{name}_v_weight")
+            f1 = _Node(fcdef, f"{name}_v", f1_attrs, [data_in, (f1_w, 0)])
+            f2_attrs = {
+                "num_hidden": str(params["num_hidden"]),
+                "no_bias": str(bool(params.get("no_bias", False))),
+            }
+            f2_w = _Node(None, f"{name}_h_weight")
+            f2_inputs = [(f1, 0), (f2_w, 0)]
+            if bias_in is not None:
+                f2_inputs.append(bias_in)
+            f2 = _Node(fcdef, f"{name}_h", f2_attrs, f2_inputs)
+            arg_params[f"{name}_v_weight"] = _nd(W1)
+            arg_params[f"{name}_h_weight"] = _nd(W2)
+            replaced[id(node)] = f2
+            new_nodes.append(f1)
+
+    if replaced:
+        # rewire every consumer edge and the heads; the fresh v/fc1 nodes
+        # also consume old edges (conv->conv chains), so include them
+        for node in sym._topo() + new_nodes:
+            node.inputs = [
+                (replaced.get(id(n), n), ix) for (n, ix) in node.inputs
+            ]
+        sym._outputs = [
+            (replaced.get(id(n), n), ix) for (n, ix) in sym._outputs
+        ]
+    return sym, arg_params, report
+
+
+def _nd(a):
+    from mxnet_tpu.ndarray import array
+
+    return array(np.ascontiguousarray(a))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", required=True, help="checkpoint prefix")
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--speedup", type=float, default=2.0)
+    ap.add_argument("--data-shape", default="3,224,224")
+    ap.add_argument("--min-rank", type=int, default=4)
+    ap.add_argument("--save-model", required=True)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.model, args.epoch)
+    shape = tuple(int(x) for x in args.data_shape.split(","))
+    new_sym, new_args, report = factorize(
+        sym, arg_params, speedup=args.speedup, data_shape=shape,
+        min_rank=args.min_rank)
+    for layer, (rank, full, kept) in sorted(report.items()):
+        print(f"{layer}: rank {rank}/{full} ({100 * kept:.1f}% energy)")
+    mx.model.save_checkpoint(args.save_model, 0, new_sym, new_args,
+                             aux_params)
+    print(f"wrote {args.save_model}-symbol.json / -0000.params "
+          f"(fine-tune with Module.fit to recover accuracy)")
+
+
+if __name__ == "__main__":
+    main()
