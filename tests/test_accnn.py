@@ -122,3 +122,28 @@ def test_accnn_full_rank_keeps_layer():
         skip=("tiny",))
     assert "tiny_weight" in new_sym2.list_arguments()
     assert "tiny" not in report2
+
+
+def test_accnn_skips_dilated_and_tiny_layers():
+    rs = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(2, 2),
+                           dilate=(2, 2), name="dil")
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Flatten(c), num_hidden=2, name="out"),
+        name="softmax")
+    args = {
+        "dil_weight": mx.nd.array(rs.randn(4, 1, 3, 3).astype(np.float32)),
+        "dil_bias": mx.nd.zeros((4,)),
+        "out_weight": mx.nd.array(rs.randn(2, 1024).astype(np.float32)),
+        "out_bias": mx.nd.zeros((2,)),
+    }
+    # dilated conv must keep its geometry; min_rank=4 > 2 singular values
+    # of the tiny FC must clamp to full rank, not crash
+    new_sym, new_args, report = factorize(
+        sym, args, speedup=4.0, data_shape=(1, 16, 16), min_rank=4)
+    assert "dil_weight" in new_sym.list_arguments()
+    assert "dil" not in report
+    # graph still binds with the returned params
+    exe = new_sym.simple_bind(mx.cpu(), grad_req="null", data=(1, 1, 16, 16))
+    exe.copy_params_from(new_args, {})

@@ -96,9 +96,27 @@ def factorize(symbol, arg_params, speedup=2.0, data_shape=(3, 224, 224),
     _, out_shapes, _ = internals.infer_shape(data=(1,) + tuple(data_shape))
     shape_of = dict(zip(internals.list_outputs(), out_shapes))
 
+    # classifier heads (layers consumed only by loss ops) are excluded by
+    # default: their rank IS the class count, so truncating it destroys
+    # the model for negligible FLOPs
+    consumers = {}
+    for n in sym._topo():
+        if n.is_variable:
+            continue
+        for (inp, _ix) in n.inputs:
+            consumers.setdefault(id(inp), []).append(n)
+    head_feeders = set()
+    for n in sym._topo():
+        if n.is_variable:
+            continue
+        cons = consumers.get(id(n), [])
+        if cons and all(getattr(c.op, "is_loss", False) for c in cons):
+            head_feeders.add(n.name)
+
     plans = []
     for node in sym._topo():
-        if node.is_variable or node.name in skip:
+        if node.is_variable or node.name in skip \
+                or node.name in head_feeders:
             continue
         params = node.params()
         wname = f"{node.name}_weight"
@@ -107,8 +125,10 @@ def factorize(symbol, arg_params, speedup=2.0, data_shape=(3, 224, 224),
         W = np.asarray(arg_params[wname].asnumpy())
         if node.op.name == "Convolution":
             kh, kw = params["kernel"]
-            if kh <= 1 or kw <= 1 or params.get("num_group", 1) != 1:
-                continue
+            dil = params.get("dilate") or (1, 1)
+            if kh <= 1 or kw <= 1 or params.get("num_group", 1) != 1 \
+                    or tuple(dil) != (1, 1):
+                continue  # grouped/dilated convs keep their geometry
             out_shape = shape_of.get(f"{node.name}_output")
             if out_shape is None or len(out_shape) != 4:
                 continue
@@ -162,14 +182,14 @@ def factorize(symbol, arg_params, speedup=2.0, data_shape=(3, 224, 224),
         bias_in = None
         if not params.get("no_bias", False):
             bias_in = node.inputs[len(node.op.arg_names(params)) - 1]
-        e = np.cumsum(p.svals ** 2)
-        kept = float(e[rank - 1] / e[-1])
-        report[name] = (rank, len(p.svals), kept)
+        rank = min(rank, len(p.svals))  # min_rank may exceed a tiny layer
         if rank >= len(p.svals):
             # full rank: splitting would only add FLOPs; keep the layer
             arg_params[f"{name}_weight"] = _nd(W)
-            report[name] = (rank, len(p.svals), 1.0)
+            report[name] = (len(p.svals), len(p.svals), 1.0)
             continue
+        e = np.cumsum(p.svals ** 2)
+        report[name] = (rank, len(p.svals), float(e[rank - 1] / e[-1]))
         if p.kind == "conv":
             V, H = _split_conv_weights(W, rank)
             kh, kw = params["kernel"]
@@ -200,12 +220,14 @@ def factorize(symbol, arg_params, speedup=2.0, data_shape=(3, 224, 224),
             new_nodes.append(v_node)
         else:
             W1, W2 = _split_fc_weights(W, rank)
-            f1_attrs = {"num_hidden": str(rank), "no_bias": "True"}
+            f1_attrs = {"num_hidden": str(rank), "no_bias": "True",
+                        "flatten": str(bool(params.get("flatten", True)))}
             f1_w = _Node(None, f"{name}_v_weight")
             f1 = _Node(fcdef, f"{name}_v", f1_attrs, [data_in, (f1_w, 0)])
             f2_attrs = {
                 "num_hidden": str(params["num_hidden"]),
                 "no_bias": str(bool(params.get("no_bias", False))),
+                "flatten": "True",  # f1's output is already 2-d
             }
             f2_w = _Node(None, f"{name}_h_weight")
             f2_inputs = [(f1, 0), (f2_w, 0)]
