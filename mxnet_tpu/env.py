@@ -60,6 +60,13 @@ _declare("MXNET_BACKWARD_DO_MIRROR", _parse_bool, False,
          "When true, executors run backward with jax.checkpoint-style "
          "rematerialisation to trade compute for activation memory "
          "(reference mirror option, graph_executor.cc:222-280).")
+_declare("MXNET_PACK_SMALL_PARAMS", _parse_bool, True,
+         "Pack small f32 parameters/aux/grads/optimizer-state tensors "
+         "(BN scalars, biases) into one flat device buffer per family at "
+         "the training-program boundary — hundreds of tiny XLA boundary "
+         "tensors otherwise each pay an async staging copy per step. "
+         "Disabled automatically under meshes/sharding, ctx-group "
+         "placement and NaiveEngine.")
 _declare("MXNET_PP_MICROBATCHES", int, 0,
          "GPipe microbatch count used when SequentialModule lowers to the "
          "pipeline schedule under a 'pp' mesh axis; 0 = the pp degree. "

@@ -68,6 +68,34 @@ def _lazy_placeholder(shape, dtype):
     return nd
 
 
+def _fill_packed(vals, flat, fill):
+    """Replace None entries of ``vals`` with static slices of ``flat``.
+
+    ``fill`` is a static tuple of (index, offset, size, shape); under jit
+    the slices are free (fused into their consumers)."""
+    if not fill or flat is None:
+        return list(vals)
+    out = list(vals)
+    for i, off, size, shape in fill:
+        out[i] = flat[off:off + size].reshape(shape)
+    return out
+
+
+def _split_out(vals, fill):
+    """Inverse of _fill_packed for program OUTPUTS: gather the packed
+    positions into one flat f32 buffer, leaving None in their slots."""
+    import jax.numpy as jnp
+
+    if not fill:
+        return list(vals), None
+    out = list(vals)
+    segs = []
+    for i, off, size, shape in fill:
+        segs.append(out[i].astype(jnp.float32).ravel())
+        out[i] = None
+    return out, jnp.concatenate(segs)
+
+
 def _head_loss_flags(graph):
     """Which graph heads are loss outputs (drive an implicit backward).
 
@@ -373,6 +401,169 @@ class Executor:
     def _aux_vals(self):
         return [self.aux_dict[n]._data for n in self.aux_names]
 
+    # --- small-parameter packing ---------------------------------------
+    # A ResNet-scale training step moves ~500 tiny f32 tensors (BN scalars,
+    # biases, their grads/momenta/statistics) across the program boundary
+    # every iteration; XLA stages each through its own async VMEM copy and
+    # the measured wait cost is ~5% of the step (see docs/architecture.md
+    # perf notes). Packing them into one flat f32 buffer per family (args /
+    # aux / grads / optimizer state) collapses those hundreds of boundary
+    # tensors into four. The flat buffers are the device-resident source
+    # of truth on the hot path; the per-name NDArray handles stay coherent
+    # through lazy slice thunks (a read costs one slice dispatch; a user
+    # write is detected and folded back into the flat before the next
+    # step). Disabled under meshes/sharding, NaiveEngine, ctx-group
+    # placement, or MXNET_PACK_SMALL_PARAMS=0.
+    _PACK_MAX_ELEMS = 8192
+
+    def _pack_eligible(self, arr):
+        import jax
+
+        return (
+            arr is not None
+            and str(arr.dtype) == "float32"
+            and 0 < arr.size <= self._PACK_MAX_ELEMS
+            and isinstance(getattr(arr, "sharding", None),
+                           jax.sharding.SingleDeviceSharding)
+        )
+
+    def _small_state(self):
+        """Packing state, built on first use (None when disabled)."""
+        if getattr(self, "_small", False) is not False:
+            return self._small
+        from . import env as _env
+
+        self._small = None
+        if (not _env.get("MXNET_PACK_SMALL_PARAMS") or self._naive
+                or self._node2dev or self._in_shardings):
+            return None
+        from .parallel.mesh import current_mesh
+
+        if current_mesh() is not None:
+            return None
+
+        def build(names, handles):
+            sel = [n for n in names if self._pack_eligible(handles[n]._d)]
+            if len(sel) < 8:
+                return None  # not worth a layout for a handful of tensors
+            offs = {}
+            off = 0
+            for n in sel:
+                a = handles[n]._d
+                offs[n] = (off, int(a.size), tuple(a.shape))
+                off += int(a.size)
+            return {"names": sel, "offs": offs, "total": off,
+                    "flat": None, "cells": {}}
+
+        arg_pack = build(
+            [n for n in self._wrt_names if self.grad_req[n] == "write"],
+            self.arg_dict)
+        aux_pack = build(self.aux_names, self.aux_dict)
+        if arg_pack is None and aux_pack is None:
+            return None
+        grad_pack = None
+        if arg_pack is not None:
+            # gradients of the packed args share the arg layout but have
+            # their own flat buffer + coherence cells
+            grad_pack = {"names": arg_pack["names"],
+                         "offs": arg_pack["offs"],
+                         "total": arg_pack["total"],
+                         "flat": None, "cells": {}}
+        self._small = {"arg": arg_pack, "aux": aux_pack, "grad": grad_pack}
+        return self._small
+
+    def _install_grad_flat(self, grad_flat):
+        small = self._small_state()
+        if grad_flat is None or not small or small["grad"] is None:
+            return
+        self._pack_install(small["grad"], self.grad_dict, grad_flat,
+                           force=True)
+
+    @staticmethod
+    def _pack_clean(pack, handles):
+        """True when no packed handle was written since the last install."""
+        cells = pack["cells"]
+        for n in pack["names"]:
+            h = handles[n]
+            c = cells.get(n)
+            if c is None:
+                return False  # never installed: flat not built yet
+            if h._lazy is c or h._d is c or (
+                    isinstance(c, tuple) and h._d is c[0]):
+                continue
+            return False
+        return True
+
+    def _pack_gather(self, pack, handles):
+        """Current flat for ``pack``, folding in any user writes."""
+        import jax.numpy as jnp
+
+        if pack is None:
+            return None
+        if pack["flat"] is not None and self._pack_clean(pack, handles):
+            return pack["flat"]
+        flat = jnp.concatenate(
+            [jnp.asarray(handles[n]._data, jnp.float32).ravel()
+             for n in pack["names"]])
+        self._pack_install(pack, handles, flat, fold=True)
+        return flat
+
+    def _pack_install(self, pack, handles, flat, fold=False, force=False):
+        """Adopt ``flat`` as the family's source of truth; handles become
+        lazy slice thunks. A handle written since the last install keeps
+        the user's value (last-write-wins) — unless ``fold`` (the flat was
+        just built FROM the handles, so their values are already in it and
+        they now count as clean)."""
+        pack["flat"] = flat
+        cells = pack["cells"]
+        for n in pack["names"]:
+            h = handles[n]
+            c = cells.get(n)
+            dirty = not force and c is not None and not (
+                h._lazy is c or h._d is c
+                or (isinstance(c, tuple) and h._d is c[0]))
+            if dirty:
+                if fold:
+                    cells[n] = (h._d,)  # value folded into the new flat
+                continue  # keep the handle's (newer) value
+
+            off, size, shape = pack["offs"][n]
+
+            def thunk(h=h, n=n, off=off, size=size, shape=shape,
+                      pack=pack, cells=cells):
+                if pack["flat"] is None:
+                    raise MXNetError(
+                        "packed parameter buffer was invalidated by a "
+                        "failed fused step; re-initialize via "
+                        "set_params()/load before reading")
+                val = pack["flat"][off:off + size].reshape(shape)
+                cells[n] = (val,)
+                h._data = val
+
+            thunk.shape = shape
+            thunk.dtype = np.float32
+            cells[n] = thunk
+            h._set_lazy(thunk)
+
+    def _split_vals(self, names, handles, pack):
+        """(vals list with None at packed positions, flat-or-None)."""
+        if pack is None:
+            return [handles[n]._data for n in names], None
+        flat = self._pack_gather(pack, handles)
+        packed = set(pack["names"])
+        vals = [None if n in packed else handles[n]._data for n in names]
+        return vals, flat
+
+    def _arg_vals_split(self):
+        small = self._small_state()
+        return self._split_vals(
+            self.arg_names, self.arg_dict, small["arg"] if small else None)
+
+    def _aux_vals_split(self):
+        small = self._small_state()
+        return self._split_vals(
+            self.aux_names, self.aux_dict, small["aux"] if small else None)
+
     def _rng_key(self):
         """Per-step rng as a (base_key, step) pair of DEVICE values.
 
@@ -404,6 +595,11 @@ class Executor:
 
         from .parallel.mesh import current_mesh
 
+        small = self._small_state()
+        arg_pack = small["arg"] if small else None
+        aux_pack = small["aux"] if small else None
+        arg_fill = self._pack_fill(self.arg_names, arg_pack)
+        aux_fill = self._pack_fill(self.aux_names, aux_pack)
         cache_key = (
             kind,
             is_train,
@@ -412,6 +608,7 @@ class Executor:
             tuple((n, self.aux_dict[n].shape, str(self.aux_dict[n].dtype)) for n in self.aux_names),
             tuple(self._wrt_names),
             tuple(sorted((n, r) for n, r in self.grad_req.items())),
+            arg_fill, aux_fill,
             # ops may bake the ambient mesh into the trace (RingAttention's
             # shard_map); a program traced under one mesh context must not
             # be served under another
@@ -424,23 +621,41 @@ class Executor:
 
         if kind == "forward":
 
-            def _fwd(arg_vals, aux_vals, rng):
+            def _fwd(arg_vals, arg_flat, aux_vals, aux_flat, rng):
+                full_args = _fill_packed(arg_vals, arg_flat, arg_fill)
+                full_aux = _fill_packed(aux_vals, aux_flat, aux_fill)
                 outs, aux_upd = graph.evaluate(
-                    arg_vals, aux_vals, _fold_rng(rng), is_train
+                    full_args, full_aux, _fold_rng(rng), is_train
                 )
-                return outs, aux_upd, _next_step(rng)
+                aux_big, aux_flat_out = _split_out(aux_upd, aux_fill)
+                return outs, aux_big, aux_flat_out, _next_step(rng)
 
             fn = _fwd if (self._node2dev or self._naive) else jax.jit(
                 _fwd, compiler_options=_tpu_compiler_options(self._ctx)
             )
         elif kind == "train_step":
             core = self._make_grad_core()
+            grad_names = tuple(arg_pack["names"]) if arg_pack else ()
 
-            def _tstep(arg_vals, aux_vals, rng, heads, prev):
+            def _tstep(arg_vals, arg_flat, aux_vals, aux_flat, rng, heads,
+                       prev):
+                import jax.numpy as jnp
+
+                full_args = _fill_packed(arg_vals, arg_flat, arg_fill)
+                full_aux = _fill_packed(aux_vals, aux_flat, aux_fill)
                 outs, aux_upd, grad_map = core(
-                    arg_vals, aux_vals, rng, heads, prev
+                    full_args, full_aux, rng, heads, prev
                 )
-                return outs, aux_upd, grad_map, _next_step(rng)
+                aux_big, aux_flat_out = _split_out(aux_upd, aux_fill)
+                grad_flat = None
+                if grad_names:
+                    grad_map = dict(grad_map)
+                    grad_flat = jnp.concatenate([
+                        grad_map.pop(n).astype(jnp.float32).ravel()
+                        for n in grad_names
+                    ])
+                return (outs, aux_big, aux_flat_out, grad_map, grad_flat,
+                        _next_step(rng))
 
             # ctx-group placement spans devices: XLA compiles single-device
             # (or SPMD-sharded) programs only, so a placed graph executes
@@ -453,6 +668,17 @@ class Executor:
             raise MXNetError(f"unknown jit kind {kind}")
         self._jit_cache[cache_key] = fn
         return fn
+
+    @staticmethod
+    def _pack_fill(order, pack):
+        """Static (index, offset, size, shape) tuples mapping a pack's
+        names onto their positions in ``order``."""
+        if pack is None:
+            return ()
+        packed = set(pack["names"])
+        return tuple(
+            (i, *pack["offs"][n]) for i, n in enumerate(order) if n in packed
+        )
 
     def _make_grad_core(self):
         """Shared fwd+bwd tracing core used by both the plain train_step
@@ -552,8 +778,8 @@ class Executor:
         # mutating a bound arg after forward() doesn't change the scheduled
         # result (engine read-ordering semantics, threaded_engine.h:93-195)
         # and (b) BatchNorm moving stats update exactly once per forward().
-        self._args_in = self._arg_vals()
-        self._aux_in = self._aux_vals()
+        self._args_in, self._args_flat_in = self._arg_vals_split()
+        self._aux_in, self._aux_flat_in = self._aux_vals_split()
         self._fwd_rng = self._rng_key()
         self._fwd_rng_val = self._step
         # engine read-ordering also covers AMBIENT context: the mesh in
@@ -574,8 +800,13 @@ class Executor:
         if self._pending is None:
             return
         is_train = self._pending == "train"
-        args_in = getattr(self, "_args_in", None) or self._arg_vals()
-        aux_in = getattr(self, "_aux_in", None) or self._aux_vals()
+        args_in = getattr(self, "_args_in", None)
+        if args_in is None:
+            args_in, self._args_flat_in = self._arg_vals_split()
+            self._aux_in, self._aux_flat_in = self._aux_vals_split()
+        aux_in = self._aux_in
+        args_flat = getattr(self, "_args_flat_in", None)
+        aux_flat = getattr(self, "_aux_flat_in", None)
         rng = getattr(self, "_fwd_rng", None) or self._rng_key()
         from .parallel.mesh import current_mesh, with_mesh
 
@@ -584,22 +815,42 @@ class Executor:
             import jax
 
             with with_mesh(mesh):
+                small = self._small_state()
                 outs, aux_upd = self.graph.evaluate(
-                    args_in,
-                    aux_in,
+                    _fill_packed(args_in, args_flat,
+                                 self._pack_fill(self.arg_names,
+                                                 small["arg"] if small
+                                                 else None)),
+                    _fill_packed(aux_in, aux_flat,
+                                 self._pack_fill(self.aux_names,
+                                                 small["aux"] if small
+                                                 else None)),
                     jax.random.fold_in(rng[0], int(rng[1])),
                     is_train,
                     monitor=self._monitor_callback,
                 )
+            aux_flat_out = None
+            if small and small["aux"]:
+                # re-pack the interpreter's full aux list
+                import jax.numpy as jnp
+
+                packed = set(small["aux"]["names"])
+                aux_flat_out = jnp.concatenate([
+                    v.astype(jnp.float32).ravel()
+                    for n, v in zip(self.aux_names, aux_upd) if n in packed
+                ])
+                aux_upd = [None if n in packed else v
+                           for n, v in zip(self.aux_names, aux_upd)]
         else:
             with with_mesh(mesh):
                 fn = self._get_jit("forward", is_train=is_train)
-                outs, aux_upd, next_step = fn(args_in, aux_in, rng)
+                outs, aux_upd, aux_flat_out, next_step = fn(
+                    args_in, args_flat, aux_in, aux_flat, rng)
             self._accept_next_step(
                 next_step, getattr(self, "_fwd_rng_val", self._step)
             )
         self._set_outputs(outs)
-        self._set_aux(aux_upd)
+        self._set_aux(aux_upd, flat=aux_flat_out)
         self._pending = None
         self._fresh = True
 
@@ -607,10 +858,14 @@ class Executor:
         for h, o in zip(self._output_handles, outs):
             h._data = o
 
-    def _set_aux(self, aux_upd, snap=None):
+    def _set_aux(self, aux_upd, snap=None, flat=None):
         if snap is None:
             snap = getattr(self, "_aux_in", None)
+        small = self._small_state()
+        packed = set(small["aux"]["names"]) if small and small["aux"] else ()
         for i, (n, v) in enumerate(zip(self.aux_names, aux_upd)):
+            if n in packed:
+                continue  # carried by the flat; installed below
             handle = self.aux_dict[n]
             # last-write-wins: if someone wrote to this aux between forward()
             # and materialisation (e.g. copy_params_from), keep their value —
@@ -618,6 +873,8 @@ class Executor:
             if snap is not None and handle._d is not snap[i]:
                 continue
             handle._data = v
+        if packed and flat is not None:
+            self._pack_install(small["aux"], self.aux_dict, flat)
 
     @property
     def outputs(self):
@@ -665,8 +922,14 @@ class Executor:
             for n in self._wrt_names
             if self.grad_req[n] == "add"
         }
-        self._bwd_args = getattr(self, "_args_in", None) or self._arg_vals()
-        self._bwd_aux = getattr(self, "_aux_in", None) or self._aux_vals()
+        if getattr(self, "_args_in", None) is not None:
+            self._bwd_args = self._args_in
+            self._bwd_args_flat = getattr(self, "_args_flat_in", None)
+            self._bwd_aux = self._aux_in
+            self._bwd_aux_flat = getattr(self, "_aux_flat_in", None)
+        else:
+            self._bwd_args, self._bwd_args_flat = self._arg_vals_split()
+            self._bwd_aux, self._bwd_aux_flat = self._aux_vals_split()
         self._bwd_heads = head_grads
         self._bwd_scheduled = True
         self._bwd_rng = self._rng_key()
@@ -689,18 +952,20 @@ class Executor:
 
         with with_mesh(getattr(self, "_bwd_mesh", current_mesh())):
             fn = self._get_jit("train_step", with_head_grads=with_hg)
-            outs, aux_upd, grad_map, next_step = fn(
-                self._bwd_args, self._bwd_aux, self._bwd_rng, head_grads,
-                self._bwd_prev,
+            outs, aux_upd, aux_flat_out, grad_map, grad_flat, next_step = fn(
+                self._bwd_args, getattr(self, "_bwd_args_flat", None),
+                self._bwd_aux, getattr(self, "_bwd_aux_flat", None),
+                self._bwd_rng, head_grads, self._bwd_prev,
             )
         self._accept_next_step(
             next_step, getattr(self, "_bwd_rng_val", self._step)
         )
         self._bwd_scheduled = False  # only consumed on success
         self._set_outputs(outs)
-        self._set_aux(aux_upd, snap=self._bwd_aux)
+        self._set_aux(aux_upd, snap=self._bwd_aux, flat=aux_flat_out)
         for n, g in grad_map.items():
             self.grad_dict[n]._data = g
+        self._install_grad_flat(grad_flat)
         self._pending = None
         self._fresh = True
 
@@ -752,14 +1017,21 @@ class Executor:
         with_hg = head_grads is not None
 
         flat_in = (
-            isinstance(states, tuple) and len(states) == 2
-            and isinstance(states[0], list)
+            isinstance(states, tuple) and len(states) in (2, 3)
+            and (isinstance(states[0], list)
+                 or (len(states) == 3 and states[0] is None))
             and isinstance(states[1], jax.tree_util.PyTreeDef)
         )
         from .parallel.mesh import current_mesh
 
+        state_handles = None
         if flat_in:
-            state_leaves, state_td = states
+            state_leaves, state_td = states[0], states[1]
+            if len(states) == 3:
+                # hot-loop protocol extension: the caller hands the NDArray
+                # leaf handles so small optimizer-state leaves can stay
+                # packed across steps (see _small_state)
+                state_handles = states[2]
         else:
             state_leaves, state_td = jax.tree_util.tree_flatten(list(states))
         # the ambient mesh can be baked into the trace (see _get_jit)
@@ -767,10 +1039,15 @@ class Executor:
         # trace (see _materialize_forward); fall back to the ambient one
         # for direct callers
         sched_mesh = getattr(self, "_bwd_mesh", current_mesh())
+        small = self._small_state()
+        arg_pack = small["arg"] if small else None
+        aux_pack = small["aux"] if small else None
         plan_key = (tuple(update_names), cache_token, with_hg, state_td,
-                    sched_mesh)
+                    state_handles is not None, sched_mesh)
         plan = self._fused_plan.get(plan_key)
         if plan is None:
+            if state_handles is not None and state_leaves is None:
+                state_leaves = [h._data for h in state_handles]
             arg_index = self.graph._arg_index
             upd_idx = [arg_index[n] for n in update_names]
             upd_set = set(upd_idx)
@@ -779,20 +1056,48 @@ class Executor:
             ]
             core = self._make_grad_core()
             n_args = len(self.arg_names)
+            arg_fill = self._pack_fill(self.arg_names, arg_pack)
+            aux_fill = self._pack_fill(self.aux_names, aux_pack)
+            packed_args = set(arg_pack["names"]) if arg_pack else ()
+            grad_names = tuple(arg_pack["names"]) if arg_pack else ()
+            # optimizer-state leaf packing: its layout lives in the plan
+            # (leaf structure is plan-specific); only available when the
+            # caller hands the leaf handles (the module hot loop)
+            st_pack = None
+            if state_handles is not None and small is not None:
+                sel = [j for j, v in enumerate(state_leaves)
+                       if self._pack_eligible(v)]
+                if len(sel) >= 8:
+                    offs = {}
+                    off = 0
+                    for j in sel:
+                        v = state_leaves[j]
+                        offs[j] = (off, int(v.size), tuple(v.shape))
+                        off += int(v.size)
+                    st_pack = {"names": sel, "offs": offs, "total": off,
+                               "flat": None, "cells": {}}
+            st_fill = tuple(
+                (j, *st_pack["offs"][j]) for j in st_pack["names"]
+            ) if st_pack else ()
 
-            def _step(upd_vals, other_vals, aux_vals, rng, heads, prev_grads,
-                      st_leaves, hyper):
+            def _step(upd_vals, arg_flat, other_vals, aux_vals, aux_flat,
+                      rng, heads, prev_grads, st_leaves, st_flat, hyper):
+                import jax.numpy as jnp
+
                 full = [None] * n_args
                 for i, v in zip(upd_idx, upd_vals):
                     full[i] = v
                 for i, v in zip(other_idx, other_vals):
                     full[i] = v
+                full = _fill_packed(full, arg_flat, arg_fill)
+                full_aux = _fill_packed(aux_vals, aux_flat, aux_fill)
+                st_full = _fill_packed(st_leaves, st_flat, st_fill)
                 outs, aux_upd, grad_map = core(
-                    full, aux_vals, rng, heads, prev_grads
+                    full, full_aux, rng, heads, prev_grads
                 )
                 key = _fold_rng(rng)
                 lr_v, wd_v, t_v = hyper[0], hyper[1], hyper[2]
-                sts = jax.tree_util.tree_unflatten(state_td, st_leaves)
+                sts = jax.tree_util.tree_unflatten(state_td, st_full)
                 new_params, new_states = [], []
                 for i, nm in enumerate(update_names):
                     prng = jax.random.fold_in(key, 0x5EED + i)
@@ -803,28 +1108,62 @@ class Executor:
                     new_params.append(w)
                     new_states.append(s)
                 new_leaves = jax.tree_util.tree_flatten(new_states)[0]
+                new_leaves, st_flat_out = _split_out(new_leaves, st_fill)
+                # pack the small updated params / grads back into flats
+                arg_flat_out = None
+                if packed_args:
+                    newp = dict(zip(update_names, new_params))
+                    new_params = [None if nm in packed_args else w
+                                  for nm, w in zip(update_names, new_params)]
+                    segs = []
+                    for nm in grad_names:
+                        w = newp.get(nm)
+                        if w is None:  # packed but not updated: carry over
+                            w = full[arg_index[nm]]
+                        segs.append(w.astype(jnp.float32).ravel())
+                    arg_flat_out = jnp.concatenate(segs)
+                grad_flat = None
+                if grad_names:
+                    grad_map = dict(grad_map)
+                    grad_flat = jnp.concatenate([
+                        grad_map.pop(nm).astype(jnp.float32).ravel()
+                        for nm in grad_names
+                    ])
+                aux_big, aux_flat_out = _split_out(aux_upd, aux_fill)
                 # hand the next step its hyperparams without a host round
                 # trip: t advances by one for every updated param each step,
                 # lr/wd only move when a scheduler fires (host re-uploads
                 # then) — so the common-case next hyper is computable here
                 next_hyper = hyper.at[2].add(np.float32(1))
-                return outs, aux_upd, grad_map, new_params, new_leaves, \
-                    next_hyper, _next_step(rng)
+                return (outs, aux_big, aux_flat_out, grad_map, grad_flat,
+                        new_params, arg_flat_out, new_leaves, st_flat_out,
+                        next_hyper, _next_step(rng))
 
             plan = (
                 jax.jit(
-                    _step, donate_argnums=(0, 2, 6, 7),
+                    _step, donate_argnums=(0, 1, 3, 4, 8, 9, 10),
                     compiler_options=_tpu_compiler_options(self._ctx),
                 ),
-                upd_idx, other_idx,
+                upd_idx, other_idx, st_pack,
                 [None],  # AOT-compiled executable, filled on first call
             )
             self._fused_plan[plan_key] = plan
-        fn, upd_idx, other_idx, aot = plan
+        fn, upd_idx, other_idx, st_pack, aot = plan
 
         args_in = self._bwd_args
+        args_flat = getattr(self, "_bwd_args_flat", None)
+        aux_flat = getattr(self, "_bwd_aux_flat", None)
         upd_vals = [args_in[i] for i in upd_idx]
         other_vals = [args_in[i] for i in other_idx]
+        st_flat = None
+        if st_pack is not None:
+            handle_map = dict(enumerate(state_handles))
+            st_flat = self._pack_gather(st_pack, handle_map)
+            packed_j = set(st_pack["names"])
+            state_leaves = [None if j in packed_j else state_handles[j]._data
+                            for j in range(len(state_handles))]
+        elif state_handles is not None and state_leaves is None:
+            state_leaves = [h._data for h in state_handles]
         # Per-step hyperparams stay device-resident: a fresh numpy argument
         # per execute costs a blocking host->device round trip on tunneled
         # runtimes and stalls the pipeline. The program returns next step's
@@ -849,20 +1188,35 @@ class Executor:
         self._hyper_dev_cache = None  # donated below; never reuse on failure
 
         call_args = (
-            upd_vals, other_vals, self._bwd_aux, self._bwd_rng, head_grads,
-            self._bwd_prev, state_leaves, hyper,
+            upd_vals, args_flat, other_vals, self._bwd_aux, aux_flat,
+            self._bwd_rng, head_grads, self._bwd_prev, state_leaves,
+            st_flat, hyper,
         )
         from .parallel.mesh import with_mesh
 
-        with with_mesh(sched_mesh):
-            if aot[0] is None:
-                # ahead-of-time compile once, then call the executable
-                # directly: the jit re-dispatch machinery (cache lookup,
-                # arg inference) costs real milliseconds per step at this
-                # argument count
-                aot[0] = fn.lower(*call_args).compile()
-            outs, aux_upd, grad_map, new_params, new_leaves, next_hyper, \
-                next_step = aot[0](*call_args)
+        try:
+            with with_mesh(sched_mesh):
+                if aot[0] is None:
+                    # ahead-of-time compile once, then call the executable
+                    # directly: the jit re-dispatch machinery (cache lookup,
+                    # arg inference) costs real milliseconds per step at
+                    # this argument count
+                    aot[0] = fn.lower(*call_args).compile()
+                (outs, aux_upd, aux_flat_out, grad_map, grad_flat,
+                 new_params, arg_flat_out, new_leaves, st_flat_out,
+                 next_hyper, next_step) = aot[0](*call_args)
+        except Exception:
+            # the pack flats were donated: a failure after dispatch leaves
+            # them consumed. Invalidate so packed reads fail LOUDLY (the
+            # thunks raise) instead of serving deleted buffers — same
+            # terminal contract as the donated per-param weights below.
+            if small is not None:
+                for p in (small["arg"], small["aux"], small["grad"]):
+                    if p is not None and p["flat"] is not None:
+                        p["flat"] = None
+                if st_pack is not None:
+                    st_pack["flat"] = None
+            raise
         self._accept_next_step(
             next_step, getattr(self, "_bwd_rng_val", self._step)
         )
@@ -876,17 +1230,27 @@ class Executor:
         self._aux_in = None
         self._bwd_args = None
         self._bwd_aux = None
+        self._bwd_args_flat = None
+        self._bwd_aux_flat = None
         self._set_outputs(outs)
-        self._set_aux(aux_upd, snap=aux_snap)
+        self._set_aux(aux_upd, snap=aux_snap, flat=aux_flat_out)
         for nm, g in grad_map.items():
             self.grad_dict[nm]._data = g
+        self._install_grad_flat(grad_flat)
         for nm, w, old in zip(update_names, new_params, upd_vals):
+            if w is None:
+                continue  # packed: carried by arg_flat_out below
             handle = self.arg_dict[nm]
             # last-write-wins: a user write between forward() and update()
             # (set_params / copy_params_from) keeps their value, matching
             # the non-fused path's snapshot guard
             if handle._d is old:
                 handle._data = w
+        if arg_flat_out is not None and arg_pack is not None:
+            self._pack_install(arg_pack, self.arg_dict, arg_flat_out)
+        if st_pack is not None and st_flat_out is not None:
+            self._pack_install(st_pack, dict(enumerate(state_handles)),
+                               st_flat_out)
         self._pending = None
         self._fresh = True
         if flat_in:

@@ -383,11 +383,14 @@ class DataParallelExecutorGroup:
         lrs = [optimizer._get_lr(i) for i in keys]
         wds = [optimizer._get_wd(i) for i in keys]
         ts = [iuc[i] for i in keys]
-        state_leaves = [nd._data for nd in nd_leaves]
 
         try:
+            # handles protocol: the executor extracts leaf values itself so
+            # small state leaves can stay packed across steps (reading
+            # nd._data here would materialize their lazy slices every step)
             new_leaves = exe.fused_train_update(
-                names, host["apply_fn"], (state_leaves, host["state_td"]),
+                names, host["apply_fn"],
+                (None, host["state_td"], nd_leaves),
                 lrs, wds, ts, cache_token=opt_token,
             )
         except Exception as e:
@@ -402,7 +405,11 @@ class DataParallelExecutorGroup:
             )
             # a RUNTIME failure after dispatch has already consumed the
             # donated weight/state buffers — no retry is possible then
-            dead = any(
+            small = exe._small_state()
+            dead = bool(
+                small and small["arg"] and small["arg"]["flat"] is None
+                and small["arg"]["cells"]
+            ) or any(
                 getattr(exe.arg_dict[n]._d, "is_deleted", lambda: False)()
                 for n in names
                 if exe.arg_dict[n]._d is not None
@@ -415,7 +422,8 @@ class DataParallelExecutorGroup:
                 ) from e
             raise
         for nd, leaf in zip(nd_leaves, new_leaves):
-            nd._data = leaf
+            if leaf is not None:  # packed leaves stay lazy in the executor
+                nd._data = leaf
 
 
 def _optimizer_token(optimizer):

@@ -70,7 +70,9 @@ class NDArray:
 
     @property
     def _data(self):
-        if self._lazy is not None:
+        # a materialization callback may itself install a new lazy thunk
+        # (the executor's packed-parameter slices do), so loop to a value
+        while self._lazy is not None:
             cb = self._lazy
             self._lazy = None
             cb()

@@ -124,26 +124,36 @@ def test_accnn_full_rank_keeps_layer():
     assert "tiny" not in report2
 
 
-def test_accnn_skips_dilated_and_tiny_layers():
+def test_accnn_skips_dilated_heads_and_clamps_tiny_layers():
     rs = np.random.RandomState(0)
     data = mx.sym.Variable("data")
     c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(2, 2),
                            dilate=(2, 2), name="dil")
+    # "mid" is a tiny interior FC (2 singular values < min_rank=4: the
+    # clamp must keep it at full rank, not crash); "out" feeds only the
+    # loss head and must be excluded as the classifier
+    mid = mx.sym.Activation(
+        mx.sym.FullyConnected(mx.sym.Flatten(c), num_hidden=2, name="mid"),
+        act_type="relu")
     sym = mx.sym.SoftmaxOutput(
-        mx.sym.FullyConnected(mx.sym.Flatten(c), num_hidden=2, name="out"),
+        mx.sym.FullyConnected(mid, num_hidden=3, name="out"),
         name="softmax")
     args = {
         "dil_weight": mx.nd.array(rs.randn(4, 1, 3, 3).astype(np.float32)),
         "dil_bias": mx.nd.zeros((4,)),
-        "out_weight": mx.nd.array(rs.randn(2, 1024).astype(np.float32)),
-        "out_bias": mx.nd.zeros((2,)),
+        "mid_weight": mx.nd.array(rs.randn(2, 1024).astype(np.float32)),
+        "mid_bias": mx.nd.zeros((2,)),
+        "out_weight": mx.nd.array(rs.randn(3, 2).astype(np.float32)),
+        "out_bias": mx.nd.zeros((3,)),
     }
-    # dilated conv must keep its geometry; min_rank=4 > 2 singular values
-    # of the tiny FC must clamp to full rank, not crash
     new_sym, new_args, report = factorize(
         sym, args, speedup=4.0, data_shape=(1, 16, 16), min_rank=4)
-    assert "dil_weight" in new_sym.list_arguments()
-    assert "dil" not in report
+    arg_names = new_sym.list_arguments()
+    assert "dil_weight" in arg_names and "dil" not in report
+    assert "out_weight" in arg_names and "out" not in report  # head kept
+    # the tiny FC hit the clamp: full rank, layer kept verbatim
+    assert "mid_weight" in arg_names
+    assert report["mid"][0] == report["mid"][1] == 2
     # graph still binds with the returned params
     exe = new_sym.simple_bind(mx.cpu(), grad_req="null", data=(1, 1, 16, 16))
     exe.copy_params_from(new_args, {})

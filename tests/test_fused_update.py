@@ -118,12 +118,13 @@ def test_forward_after_backward_preserves_ordering():
     got = out2[0].asnumpy()
     # reference: outputs must be batch-2's eval forward, not batch-1's
     exe2 = mod._exec_group._exec
+    arg_vals, arg_flat = exe2._arg_vals_split()
+    arg_vals = [d2._data if n == "data" else v
+                for n, v in zip(exe2.arg_names, arg_vals)]
+    aux_vals, aux_flat = exe2._aux_vals_split()
     ref = np.asarray(
         exe2._get_jit("forward", is_train=False)(
-            [d2._data if n == "data" else exe2.arg_dict[n]._data
-             for n in exe2.arg_names],
-            [a._data for a in exe2.aux_arrays],
-            exe2._rng_key(),
+            arg_vals, arg_flat, aux_vals, aux_flat, exe2._rng_key(),
         )[0][0]
     )
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
