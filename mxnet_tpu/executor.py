@@ -434,8 +434,12 @@ class Executor:
         from . import env as _env
 
         self._small = None
-        if (not _env.get("MXNET_PACK_SMALL_PARAMS") or self._naive
-                or self._node2dev or self._in_shardings):
+        # the win is the fused train step's boundary; with bulk exec off
+        # the per-param update path would pay a slice dispatch per packed
+        # grad read plus a flat rebuild per step for no benefit
+        if (not _env.get("MXNET_PACK_SMALL_PARAMS")
+                or not _env.get("MXNET_EXEC_BULK_EXEC_TRAIN")
+                or self._naive or self._node2dev or self._in_shardings):
             return None
         from .parallel.mesh import current_mesh
 
@@ -829,18 +833,12 @@ class Executor:
                     is_train,
                     monitor=self._monitor_callback,
                 )
-            aux_flat_out = None
-            if small and small["aux"]:
-                # re-pack the interpreter's full aux list
-                import jax.numpy as jnp
-
-                packed = set(small["aux"]["names"])
-                aux_flat_out = jnp.concatenate([
-                    v.astype(jnp.float32).ravel()
-                    for n, v in zip(self.aux_names, aux_upd) if n in packed
-                ])
-                aux_upd = [None if n in packed else v
-                           for n, v in zip(self.aux_names, aux_upd)]
+            # re-pack the interpreter's full aux list (same split as the
+            # jitted path)
+            aux_upd, aux_flat_out = _split_out(
+                aux_upd,
+                self._pack_fill(self.aux_names,
+                                small["aux"] if small else None))
         else:
             with with_mesh(mesh):
                 fn = self._get_jit("forward", is_train=is_train)
@@ -1194,6 +1192,7 @@ class Executor:
         )
         from .parallel.mesh import with_mesh
 
+        dispatched = False
         try:
             with with_mesh(sched_mesh):
                 if aot[0] is None:
@@ -1202,17 +1201,20 @@ class Executor:
                     # arg inference) costs real milliseconds per step at
                     # this argument count
                     aot[0] = fn.lower(*call_args).compile()
+                dispatched = True
                 (outs, aux_upd, aux_flat_out, grad_map, grad_flat,
                  new_params, arg_flat_out, new_leaves, st_flat_out,
                  next_hyper, next_step) = aot[0](*call_args)
         except Exception:
-            # the pack flats were donated: a failure after dispatch leaves
-            # them consumed. Invalidate so packed reads fail LOUDLY (the
-            # thunks raise) instead of serving deleted buffers — same
-            # terminal contract as the donated per-param weights below.
-            if small is not None:
-                for p in (small["arg"], small["aux"], small["grad"]):
-                    if p is not None and p["flat"] is not None:
+            # a failure AFTER dispatch leaves the donated pack flats
+            # consumed: invalidate so packed reads fail LOUDLY (the thunks
+            # raise) instead of serving deleted buffers — same terminal
+            # contract as the donated per-param weights below. A trace or
+            # compile failure donated nothing; the packs stay intact and
+            # the caller's rollback/retry path remains valid.
+            if dispatched and small is not None:
+                for p in (small["arg"], small["aux"]):
+                    if p is not None:
                         p["flat"] = None
                 if st_pack is not None:
                     st_pack["flat"] = None

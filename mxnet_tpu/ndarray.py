@@ -71,11 +71,17 @@ class NDArray:
     @property
     def _data(self):
         # a materialization callback may itself install a new lazy thunk
-        # (the executor's packed-parameter slices do), so loop to a value
+        # (the executor's packed-parameter slices do), so loop to a value.
+        # A callback that RAISES is re-armed: its error condition must
+        # repeat on the next read, never decay into serving stale _d.
         while self._lazy is not None:
             cb = self._lazy
             self._lazy = None
-            cb()
+            try:
+                cb()
+            except BaseException:
+                self._lazy = cb
+                raise
         return self._d
 
     @_data.setter

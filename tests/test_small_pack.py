@@ -160,3 +160,48 @@ def test_packed_grad_reads_fresh_every_step():
     mod.backward()
     g3 = exe.grad_dict[name].asnumpy()
     assert not np.allclose(g2, g3), "packed grad went permanently stale"
+
+
+def test_failed_step_invalidation_semantics():
+    """A trace-time failure (nothing donated) must leave packs intact and
+    params readable; the loud-invalidation error must REPEAT on re-reads,
+    never decay into serving stale values."""
+    x, y = _data(4)
+    mod = _build()
+    _train(mod, x, y, 3)
+    exe = mod._exec_group._exec
+    small = exe._small_state()
+    assert small and small["arg"]
+    name = small["arg"]["names"][0]
+
+    # trace/compile failure: fabricate by requesting a fused update with a
+    # broken apply_fn through the raw interface
+    import jax
+
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(x[0])],
+                                label=[mx.nd.array(y[0])]), is_train=True)
+    mod.backward()
+
+    def broken_apply(i, w, g, s, lr, wd, t, rng):
+        raise RuntimeError("boom at trace time")
+
+    leaves, td = jax.tree_util.tree_flatten(
+        [mx.nd.zeros(exe.arg_dict[n].shape)._data
+         for n in [name]])
+    with pytest.raises(Exception):
+        exe.fused_train_update([name], broken_apply, (leaves, td),
+                               [0.1], [0.0], [1], cache_token="broken")
+    # nothing was donated: the pack survives, params stay readable
+    assert small["arg"]["flat"] is not None
+    _ = exe.arg_dict[name].asnumpy()
+
+    # simulate a post-dispatch failure: invalidation must be sticky
+    small["arg"]["flat"] = None
+    from mxnet_tpu.base import MXNetError
+
+    fresh = small["arg"]["names"][1]
+    if exe.arg_dict[fresh]._lazy is not None:
+        with pytest.raises(MXNetError, match="invalidated"):
+            exe.arg_dict[fresh].asnumpy()
+        with pytest.raises(MXNetError, match="invalidated"):
+            exe.arg_dict[fresh].asnumpy()  # second read: same loud error
