@@ -27,10 +27,12 @@ Two lowerings, picked automatically:
 
 Scope (enforced with clear errors): every child is a plain bound
 ``Module`` with one data input, interior boundaries are single tensors of
-one shared shape/dtype, only the last child takes labels, and the child
-count equals the ``pp`` axis size. BatchNorm-style aux states update from
-the final microbatch's tick only (per-microbatch aux updates have no
-serial meaning under GPipe).
+one shared shape/dtype, and only the last child takes labels. More
+children than pipeline ranks group contiguously into balanced stages
+(each rank chains its children over the activation); fewer children than
+ranks is an error. BatchNorm-style aux states update from the final
+microbatch's tick only (per-microbatch aux updates have no serial
+meaning under GPipe).
 """
 
 from __future__ import annotations
@@ -61,10 +63,12 @@ def _graph_signature(graph, data_names, label_names, shape_of):
     return (tuple(sig), heads)
 
 
-class _StageInfo:
+class _StageUnit:
+    """One child Module inside a pipeline stage (stages may group several
+    consecutive children when the child count exceeds the pp degree)."""
+
     def __init__(self, module, takes_labels):
         self.module = module
-        self.takes_labels = takes_labels
         exe = module._exec_group._exec
         self.exec_ = exe
         self.graph = exe.graph
@@ -76,24 +80,42 @@ class _StageInfo:
         self.aux_names = list(self.graph.aux_names)
 
 
-def _build_stages(stages):
-    infos = []
+class _StageInfo:
+    def __init__(self, group):
+        self.units = [_StageUnit(st.module, st.takes_labels)
+                      for st in group]
+        self.module = group[-1].module  # stage boundary (output shapes)
+        self.label_names = self.units[-1].label_names
+        # per-stage flat orders (the engine's value tuples follow these)
+        self.param_entries = [(u, n) for u, unit in enumerate(self.units)
+                              for n in unit.param_names]
+        self.aux_entries = [(u, n) for u, unit in enumerate(self.units)
+                            for n in unit.aux_names]
+        self.param_index = {e: j for j, e in enumerate(self.param_entries)}
+        self.aux_index = {e: j for j, e in enumerate(self.aux_entries)}
+
+    @property
+    def graph(self):
+        return self.units[-1].graph  # heads/loss flags live on the tail
+
+
+def _build_stages(stages, num_stages):
     for i, st in enumerate(stages):
         mod = st.module
         if getattr(mod, "_exec_group", None) is None:
             raise MXNetError(
-                f"pipeline stage {i} is not a bound plain Module; pipelined "
+                f"pipeline child {i} is not a bound plain Module; pipelined "
                 "SequentialModule supports Module children only"
             )
         if len(mod._data_names) != 1:
             raise MXNetError(
-                f"pipeline stage {i} has {len(mod._data_names)} data "
+                f"pipeline child {i} has {len(mod._data_names)} data "
                 "inputs; the GPipe boundary carries exactly one activation"
             )
         if st.takes_labels and i != len(stages) - 1:
             raise MXNetError(
-                "only the last pipeline stage may take labels (the loss "
-                f"head); stage {i} sets take_labels"
+                "only the last pipeline child may take labels (the loss "
+                f"head); child {i} sets take_labels"
             )
         req = mod._grad_req
         reqs = set(req.values()) if isinstance(req, dict) else \
@@ -102,10 +124,21 @@ def _build_stages(stages):
             raise MXNetError(
                 "grad_req='add' accumulation is not supported by the "
                 "pipelined SequentialModule (each step writes fresh "
-                f"gradients); stage {i} requests it"
+                f"gradients); child {i} requests it"
             )
-        infos.append(_StageInfo(mod, st.takes_labels))
-    return infos
+    # contiguous balanced grouping: N children over S stages (the manual
+    # alternative the old error message demanded). The extra children go
+    # to the EARLIEST stages so the loss-head child stays alone last when
+    # the split allows.
+    n, s = len(stages), num_stages
+    base, extra = divmod(n, s)
+    groups = []
+    start = 0
+    for i in range(s):
+        size = base + (1 if i < extra else 0)
+        groups.append(list(stages[start:start + size]))
+        start += size
+    return [_StageInfo(g) for g in groups]
 
 
 class PipelineEngine:
@@ -119,12 +152,12 @@ class PipelineEngine:
         if self.S < 2:
             raise MXNetError("a pp mesh axis of size 1 pipelines nothing; "
                              "drop the pp axis or grow it")
-        if len(stages) != self.S:
+        if len(stages) < self.S:
             raise MXNetError(
-                f"{len(stages)} pipeline stages for a pp axis of size "
-                f"{self.S}; they must match (group layers per stage)"
+                f"{len(stages)} pipeline children for a pp axis of size "
+                f"{self.S}; need at least one child per stage"
             )
-        self.infos = _build_stages(stages)
+        self.infos = _build_stages(stages, self.S)
         self.M = int(num_microbatches or env_get("MXNET_PP_MICROBATCHES")
                      or self.S)
         if batch_size % self.M != 0:
@@ -149,17 +182,20 @@ class PipelineEngine:
                 f"{sorted(shapes)}; the pipeline ring buffer needs one "
                 "shape (pad or restructure stages)"
             )
-        def shape_of(info):
+        def shape_of(unit):
             def f(name, is_aux):
-                d = info.exec_.aux_dict if is_aux else info.exec_.arg_dict
+                d = unit.exec_.aux_dict if is_aux else unit.exec_.arg_dict
                 arr = d.get(name)
                 return (tuple(arr.shape), str(arr.dtype)) if arr is not None \
                     else ((), "?")
             return f
 
-        sigs = [_graph_signature(info.graph, {info.data_name},
-                                 set(info.label_names), shape_of(info))
-                for info in self.infos]
+        sigs = [
+            tuple(_graph_signature(u.graph, {u.data_name},
+                                   set(u.label_names), shape_of(u))
+                  for u in info.units)
+            for info in self.infos
+        ]
         self.homogeneous = self.S > 1 and all(s == sigs[0] for s in sigs[1:])
         from ..executor import _head_loss_flags
 
@@ -173,11 +209,12 @@ class PipelineEngine:
         """Current (param_vals, aux_vals) per stage from the child execs."""
         pvals, avals = [], []
         for info in self.infos:
-            exe = info.exec_
-            pvals.append(tuple(exe.arg_dict[n]._data
-                               for n in info.param_names))
-            avals.append(tuple(exe.aux_dict[n]._data
-                               for n in info.aux_names))
+            pvals.append(tuple(
+                info.units[u].exec_.arg_dict[n]._data
+                for u, n in info.param_entries))
+            avals.append(tuple(
+                info.units[u].exec_.aux_dict[n]._data
+                for u, n in info.aux_entries))
         return tuple(pvals), tuple(avals)
 
     # -- program construction --------------------------------------------
@@ -203,20 +240,35 @@ class PipelineEngine:
         loss_flags = _head_loss_flags(infos[-1].graph)
         num_heads = len(infos[-1].graph.heads)
 
-        def run_stage(i, a_in, labels_mb, pvals_i, avals_i, key):
+        def run_stage(i, a_in, labels_mb, pvals_i, avals_i, stage_key):
+            """Chain the stage's grouped children over the activation.
+
+            ``stage_key`` is already stage-distinct (the homogeneous path
+            folds the traced pipeline rank — a static index there would
+            hand every rank the same dropout key per tick)."""
             info = infos[i]
-            full = []
-            for n in info.graph.arg_names:
-                if n == info.data_name:
-                    full.append(a_in)
-                elif n in info.label_names:
-                    full.append(labels_mb[info.label_names.index(n)])
-                else:
-                    full.append(pvals_i[info.param_names.index(n)])
-            outs, aux_upd = info.graph.evaluate(
-                full, list(avals_i), jax.random.fold_in(key, i), is_train
-            )
-            return outs, tuple(aux_upd)
+            pidx, aidx = info.param_index, info.aux_index
+            act = a_in
+            new_aux = list(avals_i)
+            outs = None
+            for u, unit in enumerate(info.units):
+                full = []
+                for n in unit.graph.arg_names:
+                    if n == unit.data_name:
+                        full.append(act)
+                    elif n in unit.label_names:
+                        full.append(labels_mb[unit.label_names.index(n)])
+                    else:
+                        full.append(pvals_i[pidx[(u, n)]])
+                unit_aux = [new_aux[aidx[(u, n)]] for n in unit.aux_names]
+                outs, aux_upd = unit.graph.evaluate(
+                    full, unit_aux, jax.random.fold_in(stage_key, u),
+                    is_train,
+                )
+                for n, v in zip(unit.aux_names, aux_upd):
+                    new_aux[aidx[(u, n)]] = v
+                act = outs[0]
+            return outs, tuple(new_aux)
 
         def sched(pvals, avals, rng, xs, ls):
             s = jax.lax.axis_index("pp")
@@ -263,14 +315,18 @@ class PipelineEngine:
 
                 if homogeneous:
                     # identical graphs chain, so data microbatches share the
-                    # boundary shape and stage 0 can blend in via the ring
+                    # boundary shape and stage 0 can blend in via the ring.
+                    # rng: fold the TRACED rank — the static stage index is
+                    # 0 on every rank here and would replicate dropout
+                    # masks across the pipeline
                     a_in = jnp.where(s == 0, feed.astype(zero_ring.dtype),
                                      buf)
                     local_p = jax.tree_util.tree_map(lambda v: v[0], pvals)
                     local_a = jax.tree_util.tree_map(lambda v: v[0],
                                                      aux_all[0])
-                    outs_i, aux_upd = run_stage(0, a_in, labels_mb,
-                                                local_p, local_a, tick_key)
+                    outs_i, aux_upd = run_stage(
+                        0, a_in, labels_mb, local_p, local_a,
+                        jax.random.fold_in(tick_key, s))
                     ring = outs_i[0]
                     heads = tuple(outs_i[:num_heads])
                     new_aux_all = (jax.tree_util.tree_map(
@@ -292,7 +348,8 @@ class PipelineEngine:
                                 def taken(op):
                                     a, lm, aux_i = op
                                     outs_i, aux_upd = run_stage(
-                                        i, a, lm, pvals[i], aux_i, tick_key)
+                                        i, a, lm, pvals[i], aux_i,
+                                        jax.random.fold_in(tick_key, i))
                                     return tuple(outs_i), aux_upd
 
                                 def skipped(op):
@@ -309,7 +366,8 @@ class PipelineEngine:
                             else:
                                 outs_i, aux_upd = run_stage(
                                     i, a_in, labels_mb, pvals[i],
-                                    aux_all[i], tick_key)
+                                    aux_all[i],
+                                    jax.random.fold_in(tick_key, i))
                                 ring = outs_i[0].astype(zero_ring.dtype)
                                 heads = tuple(
                                     jnp.zeros(h.shape, h.dtype)
@@ -478,7 +536,7 @@ class PipelineEngine:
             else:
                 # label-less inference on a loss-headed pipeline: reuse the
                 # bound label arrays, as the serial executor group does
-                exe = self.infos[-1].exec_
+                exe = self.infos[-1].units[-1].exec_
                 labels = [exe.arg_dict[n]._data
                           for n in self.infos[-1].label_names]
         # the rng key stays device-resident across steps (each program
@@ -498,25 +556,24 @@ class PipelineEngine:
                 pvals, avals, self._rng_dev, data_v, tuple(labels))
         self._write_aux(aux_back)
         for info in self.infos:
-            # the child's param/aux snapshots are stale once the engine
-            # writes into its executor arrays; get_params must re-sync
-            info.module._params_dirty = True
+            # the children's param/aux snapshots are stale once the engine
+            # writes into their executor arrays; get_params must re-sync
+            for unit in info.units:
+                unit.module._params_dirty = True
         self._last_outputs = [NDArray(o) for o in outs]
         return self._last_outputs
 
     def _write_grads(self, grads):
         for info, g in zip(self.infos, grads):
-            exe = info.exec_
-            for n, gv in zip(info.param_names, g):
-                arr = exe.grad_dict.get(n)
+            for (u, n), gv in zip(info.param_entries, g):
+                arr = info.units[u].exec_.grad_dict.get(n)
                 if arr is not None:
                     arr._data = gv.astype(arr._data.dtype)
 
     def _write_aux(self, aux_back):
         for info, av in zip(self.infos, aux_back):
-            exe = info.exec_
-            for n, v in zip(info.aux_names, av):
-                exe.aux_dict[n]._data = v
+            for (u, n), v in zip(info.aux_entries, av):
+                info.units[u].exec_.aux_dict[n]._data = v
 
     @property
     def outputs(self):

@@ -99,8 +99,8 @@ def test_pipelined_matches_serial_loss_grads_and_update():
     ref_grads = {n: g.asnumpy() for n, g in
                  ref._exec_group._exec.grad_dict.items() if g is not None}
     for info in seq._pp_engine.infos:
-        for n in info.param_names:
-            g = info.exec_.grad_dict[n].asnumpy()
+        for (u, n) in info.param_entries:
+            g = info.units[u].exec_.grad_dict[n].asnumpy()
             assert_almost_equal(g, ref_grads[n], rtol=1e-4, atol=1e-6,
                                 names=(f"pp:{n}", f"serial:{n}"))
 
@@ -285,3 +285,98 @@ def test_pipeline_validation_errors():
         with parallel.with_mesh(mesh):
             seq2.bind(data_shapes=[("data", (BATCH, DIM))],
                       label_shapes=[("softmax_label", (BATCH,))])
+
+
+def test_children_group_into_fewer_stages():
+    """More children than pipeline ranks: contiguous balanced grouping
+    (here 6 children over pp=2 -> stages of 3+3), still serial-exact."""
+    rs = np.random.RandomState(9)
+    mesh = parallel.make_mesh({"pp": 2})
+    seq = mx.mod.SequentialModule()
+    for i in range(5):
+        d = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(d, num_hidden=HID, name=f"g{i}_fc")
+        seq.add(mx.mod.Module(
+            mx.sym.Activation(fc, act_type="tanh", name=f"g{i}_act"),
+            data_names=("data",), label_names=None), auto_wiring=i > 0)
+    d = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(d, num_hidden=NCLS, name="g5_fc")
+    seq.add(mx.mod.Module(mx.sym.SoftmaxOutput(fc, name="softmax"),
+                          data_names=("data",),
+                          label_names=("softmax_label",)),
+            take_labels=True, auto_wiring=True)
+    with parallel.with_mesh(mesh):
+        seq.bind(data_shapes=[("data", (BATCH, DIM))],
+                 label_shapes=[("softmax_label", (BATCH,))])
+    seq.init_params(initializer=mx.init.Uniform(0.5))
+    eng = seq._pp_engine
+    assert eng.S == 2 and [len(i.units) for i in eng.infos] == [3, 3]
+
+    h = mx.sym.Variable("data")
+    for i in range(5):
+        h = mx.sym.FullyConnected(h, num_hidden=HID, name=f"g{i}_fc")
+        h = mx.sym.Activation(h, act_type="tanh", name=f"g{i}_act")
+    h = mx.sym.FullyConnected(h, num_hidden=NCLS, name="g5_fc")
+    ref = mx.mod.Module(mx.sym.SoftmaxOutput(h, name="softmax"),
+                        context=mx.cpu())
+    ref.bind(data_shapes=[("data", (BATCH, DIM))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    args, auxs = seq.get_params()
+    ref.init_params(arg_params={k: v.copy() for k, v in args.items()},
+                    aux_params={k: v.copy() for k, v in auxs.items()},
+                    initializer=None)
+    batch = _batch(rs)
+    seq.forward(batch, is_train=True)
+    seq.backward()
+    ref.forward(batch, is_train=True)
+    ref.backward()
+    assert_almost_equal(seq.get_outputs()[0].asnumpy(),
+                        ref.get_outputs()[0].asnumpy(),
+                        rtol=1e-5, atol=1e-6)
+    ref_grads = {n: g.asnumpy() for n, g in
+                 ref._exec_group._exec.grad_dict.items() if g is not None}
+    for info in seq._pp_engine.infos:
+        for (u, n) in info.param_entries:
+            g = info.units[u].exec_.grad_dict[n].asnumpy()
+            assert_almost_equal(g, ref_grads[n], rtol=1e-4, atol=1e-6,
+                                names=(f"pp:{n}", f"serial:{n}"))
+
+
+def test_pipeline_with_dropout_trains():
+    """Dropout inside pipeline stages: per-(tick, stage, unit) rng folding
+    must produce stochastic but trainable behavior."""
+    rs = np.random.RandomState(4)
+    mesh = parallel.make_mesh({"pp": 2})
+    seq = mx.mod.SequentialModule()
+    d = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(d, num_hidden=HID, name="dr0_fc")
+    drop = mx.sym.Dropout(mx.sym.Activation(fc, act_type="tanh"), p=0.3,
+                          name="dr0_drop")
+    seq.add(mx.mod.Module(drop, data_names=("data",), label_names=None))
+    d = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(d, num_hidden=NCLS, name="dr1_fc")
+    seq.add(mx.mod.Module(mx.sym.SoftmaxOutput(fc, name="softmax"),
+                          data_names=("data",),
+                          label_names=("softmax_label",)),
+            take_labels=True, auto_wiring=True)
+    with parallel.with_mesh(mesh):
+        seq.bind(data_shapes=[("data", (BATCH, DIM))],
+                 label_shapes=[("softmax_label", (BATCH,))])
+    seq.init_params(initializer=mx.init.Uniform(0.5))
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = _batch(rs)
+    # stochasticity isolated from updates: two train forwards, no update
+    seq.forward(batch, is_train=True)
+    o1 = seq.get_outputs()[0].asnumpy()
+    seq.forward(batch, is_train=True)
+    o2 = seq.get_outputs()[0].asnumpy()
+    assert not np.allclose(o1, o2)  # dropout mask advanced between runs
+    seq.backward()
+    seq.update()
+    # eval mode is deterministic (dropout off)
+    seq.forward(batch, is_train=False)
+    e1 = seq.get_outputs()[0].asnumpy()
+    seq.forward(batch, is_train=False)
+    e2 = seq.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(e1, e2, rtol=1e-6)
