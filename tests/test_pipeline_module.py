@@ -380,3 +380,31 @@ def test_pipeline_with_dropout_trains():
     seq.forward(batch, is_train=False)
     e2 = seq.get_outputs()[0].asnumpy()
     np.testing.assert_allclose(e1, e2, rtol=1e-6)
+
+
+def test_grouped_stages_with_batchnorm_aux():
+    """BN aux states inside multi-child stages: the per-unit aux entry
+    plumbing must route updates back to the right child executors."""
+    rs = np.random.RandomState(6)
+    mesh = parallel.make_mesh({"pp": 2})
+    seq = mx.mod.SequentialModule()
+    for i in range(4):
+        d = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(d, num_hidden=DIM, name=f"gb{i}_fc")
+        bn = mx.sym.BatchNorm(fc, fix_gamma=False, name=f"gb{i}_bn")
+        seq.add(mx.mod.Module(
+            mx.sym.Activation(bn, act_type="tanh", name=f"gb{i}_act"),
+            data_names=("data",), label_names=None), auto_wiring=i > 0)
+    with parallel.with_mesh(mesh):
+        seq.bind(data_shapes=[("data", (BATCH, DIM))])
+    seq.init_params(initializer=mx.init.Uniform(0.5))
+    eng = seq._pp_engine
+    assert [len(i.units) for i in eng.infos] == [2, 2]
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.randn(BATCH, DIM).astype(np.float32))],
+        label=None)
+    seq.forward(batch, is_train=True)
+    _, auxs = seq.get_params()
+    moved = [n for n, v in auxs.items()
+             if "moving_mean" in n and np.abs(v.asnumpy()).max() > 1e-8]
+    assert len(moved) == 4, f"BN stats missing updates: {sorted(moved)}"
