@@ -190,6 +190,15 @@ class DataParallelExecutorGroup:
 
         self._in_shardings = in_shardings
         shared_exec = shared_group._exec if shared_group is not None else None
+        if shared_exec is None and reshape and \
+                getattr(self, "_exec", None) is not None:
+            # a reshape of a LIVE group (Module.forward on a new batch
+            # shape) must keep its trained parameters/grads/aux: share the
+            # old executor's arrays — simple_bind shares every
+            # shape-matched entry (the params) and reallocates only the
+            # shape-changed data/label buffers. Without this, a mid-epoch
+            # partial batch silently reset training to zeros.
+            shared_exec = self._exec
         self._exec = Executor.simple_bind(
             self.symbol,
             self.contexts[0],

@@ -213,3 +213,42 @@ def test_bucketing_module():
     w4 = mod._buckets[4]._exec_group._exec.arg_dict.get("shared_fc_weight")
     assert w8 is not None and w4 is not None
     assert np.array_equal(w8.asnumpy(), w4.asnumpy())
+
+
+def test_forward_with_new_batch_shape_keeps_trained_params():
+    """Regression: Module.forward on a batch of a NEW shape triggers an
+    executor-group reshape; the rebound executor must share the live
+    trained parameters — it used to reallocate them as zeros, silently
+    resetting training on any mid-epoch partial batch."""
+    rs = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=6, name="rw_fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 5))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.init.Uniform(0.5))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    b = mx.io.DataBatch(data=[mx.nd.array(rs.randn(8, 5).astype(np.float32))],
+                        label=[mx.nd.array(np.zeros(8, np.float32))])
+    for _ in range(3):
+        mod.forward_backward(b)
+        mod.update()
+    w_before = mod._exec_group._exec.arg_dict["rw_fc_weight"].asnumpy()
+    assert np.abs(w_before).max() > 0
+
+    # partial batch (different shape) flows through reshape
+    b_small = mx.io.DataBatch(
+        data=[mx.nd.array(rs.randn(3, 5).astype(np.float32))],
+        label=[mx.nd.array(np.zeros(3, np.float32))])
+    mod.forward(b_small, is_train=False)
+    assert mod.get_outputs()[0].shape == (3, 6)
+    w_after = mod._exec_group._exec.arg_dict["rw_fc_weight"].asnumpy()
+    np.testing.assert_array_equal(w_before, w_after)
+
+    # and training continues from the same weights after reshaping back
+    mod.forward_backward(b)
+    mod.update()
+    w_cont = mod._exec_group._exec.arg_dict["rw_fc_weight"].asnumpy()
+    assert not np.allclose(w_cont, w_after)  # an update happened

@@ -405,6 +405,8 @@ def test_grouped_stages_with_batchnorm_aux():
         label=None)
     seq.forward(batch, is_train=True)
     _, auxs = seq.get_params()
-    moved = [n for n, v in auxs.items()
-             if "moving_mean" in n and np.abs(v.asnumpy()).max() > 1e-8]
-    assert len(moved) == 4, f"BN stats missing updates: {sorted(moved)}"
+    all_means = [n for n in auxs if "moving_mean" in n]
+    moved = [n for n in all_means
+             if np.abs(auxs[n].asnumpy()).max() > 1e-8]
+    stuck = sorted(set(all_means) - set(moved))
+    assert not stuck, f"BN stats missing updates: {stuck}"

@@ -205,3 +205,43 @@ def test_failed_step_invalidation_semantics():
             exe.arg_dict[fresh].asnumpy()
         with pytest.raises(MXNetError, match="invalidated"):
             exe.arg_dict[fresh].asnumpy()  # second read: same loud error
+
+
+def test_packed_reshape_and_optimizer_state_roundtrip(tmp_path):
+    """Two packing edge paths: (a) executor reshape (the bucketing path)
+    must keep packed params coherent across the shape change; (b)
+    optimizer-state save/load mid-training must serialize the CURRENT
+    packed momentum values and training must resume exactly."""
+    x, y = _data(5)
+    mod = _build()
+    _train(mod, x, y, 6)
+    exe = mod._exec_group._exec
+    assert exe._small_state() is not None
+
+    # (a) reshape to a different batch, keep training
+    b2 = mx.io.DataBatch(
+        data=[mx.nd.array(np.random.RandomState(8).randn(
+            BATCH * 2, 12).astype(np.float32))],
+        label=[mx.nd.array(np.zeros(BATCH * 2, np.float32))])
+    mod.forward(b2, is_train=True)
+    mod.backward()
+    mod.update()
+    assert mod.get_outputs()[0].shape[0] == BATCH * 2
+
+    # (b) save params + optimizer states, train on, restore, retrain:
+    # the two continuations must be bit-identical
+    prefix = str(tmp_path / "ck")
+    mod.save_checkpoint(prefix, 0, save_optimizer_states=True)
+    _train(mod, x, y, 3)
+    cont_a, _ = mod.get_params()
+    cont_a = {k: v.asnumpy() for k, v in cont_a.items()}
+
+    mod2 = _build()
+    _sym, args, auxs = mx.model.load_checkpoint(prefix, 0)
+    mod2.set_params(args, auxs, force_init=True)
+    mod2.load_optimizer_states(prefix + "-0000.states")
+    _train(mod2, x, y, 3)
+    cont_b, _ = mod2.get_params()
+    for n, va in cont_a.items():
+        assert_almost_equal(va, cont_b[n].asnumpy(), rtol=1e-5, atol=1e-6,
+                            names=(f"a:{n}", f"b:{n}"))
