@@ -28,6 +28,22 @@ using mxtpu::ensure_python;
 using mxtpu::g_last_error;
 using mxtpu::set_err_from_python;
 
+// CSR-style (indptr, flat dims) input shapes -> {key: shape tuple}
+PyObject* build_shapes_dict(uint32_t num_input_nodes, const char** input_keys,
+                            const uint32_t* input_shape_indptr,
+                            const uint32_t* input_shape_data) {
+  PyObject* shapes = PyDict_New();
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyTuple_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, j - lo, PyLong_FromLong(input_shape_data[j]));
+    PyDict_SetItemString(shapes, input_keys[i], shp);
+    Py_DECREF(shp);
+  }
+  return shapes;
+}
+
 }  // namespace
 
 extern "C" {
@@ -50,15 +66,8 @@ int MXPredCreate(const char* symbol_json, const void* param_bytes,
   do {
     mod = PyImport_ImportModule("mxnet_tpu.predictor");
     if (!mod) { set_err_from_python(); rc = -1; break; }
-    shapes = PyDict_New();
-    for (uint32_t i = 0; i < num_input_nodes; ++i) {
-      uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
-      PyObject* shp = PyTuple_New(hi - lo);
-      for (uint32_t j = lo; j < hi; ++j)
-        PyTuple_SET_ITEM(shp, j - lo, PyLong_FromLong(input_shape_data[j]));
-      PyDict_SetItemString(shapes, input_keys[i], shp);
-      Py_DECREF(shp);
-    }
+    shapes = build_shapes_dict(num_input_nodes, input_keys,
+                               input_shape_indptr, input_shape_data);
     PyObject* params =
         PyBytes_FromStringAndSize((const char*)param_bytes, param_size);
     const char* dev = dev_type == 2 ? "gpu" : "cpu";
@@ -160,6 +169,158 @@ int MXPredFree(PredictorHandle handle) {
   Py_XDECREF(h->obj);
   PyGILState_Release(gil);
   delete h;
+  return 0;
+}
+
+int MXPredCreatePartialOut(const char* symbol_json, const void* param_bytes,
+                           int param_size, int dev_type, int dev_id,
+                           uint32_t num_input_nodes, const char** input_keys,
+                           const uint32_t* input_shape_indptr,
+                           const uint32_t* input_shape_data,
+                           uint32_t num_output_nodes,
+                           const char** output_keys, PredictorHandle* out) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 0;
+  PyObject* mod = nullptr;
+  PyObject* shapes = nullptr;
+  PyObject* keys = nullptr;
+  PyObject* pred = nullptr;
+  do {
+    mod = PyImport_ImportModule("mxnet_tpu.predictor");
+    if (!mod) { set_err_from_python(); rc = -1; break; }
+    shapes = build_shapes_dict(num_input_nodes, input_keys,
+                               input_shape_indptr, input_shape_data);
+    keys = PyList_New(num_output_nodes);
+    for (uint32_t i = 0; i < num_output_nodes; ++i)
+      PyList_SET_ITEM(keys, i, PyUnicode_FromString(output_keys[i]));
+    PyObject* params =
+        PyBytes_FromStringAndSize((const char*)param_bytes, param_size);
+    const char* dev = dev_type == 2 ? "gpu" : "cpu";
+    pred = PyObject_CallMethod(mod, "create_predictor_partial", "sOOOsi",
+                               symbol_json, params, shapes, keys, dev,
+                               dev_id);
+    Py_DECREF(params);
+    if (!pred) { set_err_from_python(); rc = -1; break; }
+    Predictor* h = new Predictor();
+    h->obj = pred;
+    pred = nullptr;
+    *out = h;
+  } while (false);
+  Py_XDECREF(mod);
+  Py_XDECREF(shapes);
+  Py_XDECREF(keys);
+  Py_XDECREF(pred);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredPartialForward(PredictorHandle handle, int step, int* step_left) {
+  Predictor* h = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(h->obj, "partial_forward", "i", step);
+  int rc = 0;
+  if (!r) {
+    set_err_from_python();
+    rc = -1;
+  } else {
+    if (step_left) *step_left = (int)PyLong_AsLong(r);
+  }
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+namespace {
+// NDList: fully copied into C storage at create time, so Get needs no GIL
+struct NDList {
+  std::vector<std::string> keys;
+  std::vector<std::vector<float>> data;
+  std::vector<std::vector<uint32_t>> shapes;
+};
+}  // namespace
+
+int MXNDListCreate(const char* nd_file_bytes, int nd_file_size,
+                   NDListHandle* out, uint32_t* out_length) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 0;
+  PyObject* mod = nullptr;
+  PyObject* r = nullptr;
+  do {
+    mod = PyImport_ImportModule("mxnet_tpu.predictor");
+    if (!mod) { set_err_from_python(); rc = -1; break; }
+    PyObject* blob =
+        PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+    r = PyObject_CallMethod(mod, "load_ndlist", "N", blob);
+    if (!r) { set_err_from_python(); rc = -1; break; }
+    NDList* list = new NDList();
+    Py_ssize_t n = PySequence_Size(r);
+    bool ok = true;
+    for (Py_ssize_t i = 0; i < n && ok; ++i) {
+      PyObject* item = PySequence_GetItem(r, i);  // (key, np.float32 arr)
+      PyObject* key = item ? PySequence_GetItem(item, 0) : nullptr;
+      PyObject* arr = item ? PySequence_GetItem(item, 1) : nullptr;
+      const char* kc = key ? PyUnicode_AsUTF8(key) : nullptr;
+      PyObject* shp = arr ? PyObject_GetAttrString(arr, "shape") : nullptr;
+      PyObject* bytes =
+          arr ? PyObject_CallMethod(arr, "tobytes", nullptr) : nullptr;
+      if (kc && shp && bytes) {
+        list->keys.emplace_back(kc);
+        std::vector<uint32_t> dims;
+        Py_ssize_t nd = PySequence_Size(shp);
+        for (Py_ssize_t d = 0; d < nd; ++d) {
+          PyObject* dd = PySequence_GetItem(shp, d);
+          dims.push_back((uint32_t)PyLong_AsUnsignedLong(dd));
+          Py_XDECREF(dd);
+        }
+        list->shapes.push_back(std::move(dims));
+        char* buf;
+        Py_ssize_t len;
+        PyBytes_AsStringAndSize(bytes, &buf, &len);
+        list->data.emplace_back((const float*)buf,
+                                (const float*)(buf + len));
+      } else {
+        ok = false;
+      }
+      Py_XDECREF(bytes);
+      Py_XDECREF(shp);
+      Py_XDECREF(arr);
+      Py_XDECREF(key);
+      Py_XDECREF(item);
+    }
+    if (!ok) {
+      delete list;
+      set_err_from_python();
+      rc = -1;
+      break;
+    }
+    *out = list;
+    if (out_length) *out_length = (uint32_t)list->keys.size();
+  } while (false);
+  Py_XDECREF(mod);
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDListGet(NDListHandle handle, uint32_t index, const char** out_key,
+                const float** out_data, const uint32_t** out_shape,
+                uint32_t* out_ndim) {
+  NDList* list = static_cast<NDList*>(handle);
+  if (index >= list->keys.size()) {
+    g_last_error = "NDList index out of range";
+    return -1;
+  }
+  if (out_key) *out_key = list->keys[index].c_str();
+  if (out_data) *out_data = list->data[index].data();
+  if (out_shape) *out_shape = list->shapes[index].data();
+  if (out_ndim) *out_ndim = (uint32_t)list->shapes[index].size();
+  return 0;
+}
+
+int MXNDListFree(NDListHandle handle) {
+  delete static_cast<NDList*>(handle);
   return 0;
 }
 

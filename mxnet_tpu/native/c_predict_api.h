@@ -14,6 +14,7 @@ extern "C" {
 #include <stdint.h>
 
 typedef void* PredictorHandle;
+typedef void* NDListHandle;
 
 const char* MXGetLastError();
 
@@ -32,6 +33,31 @@ int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
 int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
                     uint32_t size);
 int MXPredFree(PredictorHandle handle);
+
+/* feature extraction: outputs are the NAMED internal layers (reference
+ * MXPredCreatePartialOut); keys accept "name" or "name_output" */
+int MXPredCreatePartialOut(const char* symbol_json_str,
+                           const void* param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           uint32_t num_input_nodes, const char** input_keys,
+                           const uint32_t* input_shape_indptr,
+                           const uint32_t* input_shape_data,
+                           uint32_t num_output_nodes,
+                           const char** output_keys, PredictorHandle* out);
+/* step-wise debug execution (reference MXPredPartialForward): runs the
+ * first step+1 op nodes; *step_left reports how many remain. Outputs read
+ * via MXPredGetOutput are the prefix's last node's until the next full
+ * MXPredForward. */
+int MXPredPartialForward(PredictorHandle handle, int step, int* step_left);
+
+/* ndarray-file list (reference MXNDList*): load a .params/ndarray blob —
+ * mean-image files etc. — and read (key, float32 data, shape) entries */
+int MXNDListCreate(const char* nd_file_bytes, int nd_file_size,
+                   NDListHandle* out, uint32_t* out_length);
+int MXNDListGet(NDListHandle handle, uint32_t index, const char** out_key,
+                const float** out_data, const uint32_t** out_shape,
+                uint32_t* out_ndim);
+int MXNDListFree(NDListHandle handle);
 
 #ifdef __cplusplus
 }

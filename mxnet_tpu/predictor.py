@@ -27,7 +27,12 @@ class Predictor:
     def __init__(self, symbol_json_or_file, param_source, input_shapes,
                  ctx=None, dev_type="cpu", dev_id=0, output_index=None,
                  fold_bn=True):
-        if isinstance(symbol_json_or_file, str) and symbol_json_or_file.lstrip().startswith("{"):
+        from .symbol import Symbol
+
+        if isinstance(symbol_json_or_file, Symbol):
+            symbol = symbol_json_or_file
+        elif isinstance(symbol_json_or_file, str) and \
+                symbol_json_or_file.lstrip().startswith("{"):
             symbol = fromjson(symbol_json_or_file)
         else:
             symbol = sym_load(symbol_json_or_file)
@@ -107,6 +112,7 @@ class Predictor:
     def reshape(self, input_shapes):
         """Re-bind with new input shapes (reference MXPredReshape)."""
         self.input_shapes = dict(input_shapes)
+        self._partial_outs = None  # computed by the pre-reshape executor
         self._bind()
 
     def set_input(self, name, data):
@@ -119,14 +125,19 @@ class Predictor:
     def forward(self, **kwargs):
         for k, v in kwargs.items():
             self.set_input(k, v)
+        self._partial_outs = None
         self._exec.forward(is_train=False)
 
+    def _current_outputs(self):
+        outs = getattr(self, "_partial_outs", None)
+        return outs if outs is not None else self._exec.outputs
+
     def get_output(self, index):
-        return self._exec.outputs[index].asnumpy()
+        return self._current_outputs()[index].asnumpy()
 
     @property
     def num_outputs(self):
-        return len(self._exec.outputs)
+        return len(self._current_outputs())
 
     # --- flat-buffer accessors used by the C predict shim ----------------
     # (mxnet_tpu/native/c_predict_api.cpp marshals raw float32 buffers
@@ -137,11 +148,65 @@ class Predictor:
         self.set_input(name, arr)
 
     def get_output_shape(self, index):
-        return tuple(self._exec.outputs[index].shape)
+        return tuple(self._current_outputs()[index].shape)
 
     def get_output_bytes(self, index):
         out = self.get_output(index)
         return np.ascontiguousarray(out, np.float32).tobytes()
+
+    def partial_forward(self, step):
+        """Reference MXPredPartialForward: run the first ``step + 1`` op
+        nodes of the graph (debug/feature-probe path); returns the number
+        of steps remaining. The prefix's last outputs become the current
+        outputs until the next full forward()/reshape(). Each call
+        re-interprets the prefix from scratch (as the un-jitted reference
+        debug path does), so a full 0..N walk costs O(N^2) op runs — jump
+        straight to the step of interest for large graphs."""
+        total = sum(1 for nd in self._exec.graph.topo if not nd.is_variable)
+        n = min(step + 1, total)
+        self._partial_outs = self._exec.partial_forward(
+            is_train=False, num_nodes=n)
+        return total - n
+
+
+def create_predictor_partial(symbol_json, param_bytes, input_shapes,
+                             output_keys, dev_type="cpu", dev_id=0):
+    """Reference MXPredCreatePartialOut: a predictor whose outputs are the
+    named INTERNAL layers (feature extraction). Keys accept both the node
+    name ("flatten0") and the output convention ("flatten0_output")."""
+    from .symbol import Group, fromjson
+
+    symbol = fromjson(symbol_json)
+    internals = symbol.get_internals()
+    names = internals.list_outputs()
+    picked = []
+    for key in output_keys:
+        cand = key if key in names else f"{key}_output"
+        if cand not in names:
+            raise MXNetError(
+                f"MXPredCreatePartialOut: no internal output {key!r} "
+                f"(known tails: {names[-5:]})"
+            )
+        picked.append(internals[names.index(cand)])
+    grouped = picked[0] if len(picked) == 1 else Group(picked)
+    # folding rewires conv weights; partial-output graphs must serve the
+    # UNfolded internals the caller named
+    return Predictor(grouped, param_bytes, input_shapes,
+                     dev_type=dev_type, dev_id=dev_id, fold_bn=False)
+
+
+def load_ndlist(nd_bytes):
+    """Reference MXNDListCreate core: a .params/ndarray blob as an ordered
+    [(key, float32 C-order array), ...] list (mean-image files etc.).
+    Keyless list-form blobs (``nd.save(f, [arr, ...])``) get empty keys,
+    as the reference does."""
+    from .ndarray import load_buffer
+
+    loaded = load_buffer(nd_bytes)
+    items = loaded.items() if isinstance(loaded, dict) \
+        else (("", v) for v in loaded)
+    return [(k, np.ascontiguousarray(v.asnumpy(), np.float32))
+            for k, v in items]
 
 
 def load_ndarray_file(nd_bytes_or_file):

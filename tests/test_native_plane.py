@@ -238,3 +238,162 @@ def test_png_rec_falls_back_to_python_plane(tmp_path):
     batches = list(it)
     assert len(batches) == 2
     assert batches[0].data[0].shape == (2, 3, 32, 32)
+
+
+_C_PARTIAL_CLIENT = r"""
+#include <stdio.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef void* PredictorHandle;
+typedef void* NDListHandle;
+extern int MXPredCreatePartialOut(const char*, const void*, int, int, int,
+                                  uint32_t, const char**, const uint32_t*,
+                                  const uint32_t*, uint32_t, const char**,
+                                  PredictorHandle*);
+extern int MXPredSetInput(PredictorHandle, const char*, const float*, uint32_t);
+extern int MXPredForward(PredictorHandle);
+extern int MXPredPartialForward(PredictorHandle, int, int*);
+extern int MXPredGetOutputShape(PredictorHandle, uint32_t, uint32_t**, uint32_t*);
+extern int MXPredGetOutput(PredictorHandle, uint32_t, float*, uint32_t);
+extern int MXPredFree(PredictorHandle);
+extern int MXNDListCreate(const char*, int, NDListHandle*, uint32_t*);
+extern int MXNDListGet(NDListHandle, uint32_t, const char**, const float**,
+                       const uint32_t**, uint32_t*);
+extern int MXNDListFree(NDListHandle);
+extern const char* MXGetLastError();
+
+int main(int argc, char** argv) {
+  FILE* fs = fopen(argv[1], "rb");
+  fseek(fs, 0, SEEK_END); long slen = ftell(fs); fseek(fs, 0, SEEK_SET);
+  char* json = malloc(slen + 1);
+  if (fread(json, 1, slen, fs) != (size_t)slen) return 2;
+  json[slen] = 0; fclose(fs);
+  FILE* fp = fopen(argv[2], "rb");
+  fseek(fp, 0, SEEK_END); long plen = ftell(fp); fseek(fp, 0, SEEK_SET);
+  char* params = malloc(plen);
+  if (fread(params, 1, plen, fp) != (size_t)plen) return 2;
+  fclose(fp);
+
+  /* NDList: read the params blob itself as an ndarray list */
+  NDListHandle nl; uint32_t nlen;
+  if (MXNDListCreate(params, (int)plen, &nl, &nlen)) {
+    fprintf(stderr, "ndlist: %s\n", MXGetLastError()); return 1;
+  }
+  const char* k0; const float* d0; const uint32_t* s0; uint32_t nd0;
+  if (MXNDListGet(nl, 0, &k0, &d0, &s0, &nd0)) return 1;
+  printf("NDLIST %u %s %u\n", nlen, k0, nd0);
+  MXNDListFree(nl);
+
+  /* partial-out predictor on the fc layer (pre-softmax features) */
+  const char* keys[] = {"data"};
+  uint32_t indptr[] = {0, 2};
+  uint32_t dims[] = {2, 6};
+  const char* outs[] = {"fc"};
+  PredictorHandle h;
+  if (MXPredCreatePartialOut(json, params, (int)plen, 1, 0, 1, keys, indptr,
+                             dims, 1, outs, &h)) {
+    fprintf(stderr, "create: %s\n", MXGetLastError()); return 1;
+  }
+  float input[12];
+  for (int i = 0; i < 12; ++i) input[i] = 0.1f * i;
+  if (MXPredSetInput(h, "data", input, 12)) return 1;
+  if (MXPredForward(h)) { fprintf(stderr, "fwd: %s\n", MXGetLastError()); return 1; }
+  uint32_t* shp; uint32_t ndim;
+  if (MXPredGetOutputShape(h, 0, &shp, &ndim)) return 1;
+  uint32_t total = 1;
+  for (uint32_t i = 0; i < ndim; ++i) total *= shp[i];
+  float* out = malloc(total * sizeof(float));
+  if (MXPredGetOutput(h, 0, out, total)) return 1;
+  printf("FEAT");
+  for (uint32_t i = 0; i < total; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+
+  /* step-wise execution to completion */
+  int left = 1;
+  int step = 0;
+  while (left > 0) {
+    if (MXPredPartialForward(h, step, &left)) {
+      fprintf(stderr, "partial: %s\n", MXGetLastError()); return 1;
+    }
+    step++;
+  }
+  if (MXPredGetOutputShape(h, 0, &shp, &ndim)) return 1;
+  total = 1;
+  for (uint32_t i = 0; i < ndim; ++i) total *= shp[i];
+  out = realloc(out, total * sizeof(float));
+  if (MXPredGetOutput(h, 0, out, total)) return 1;
+  printf("STEPPED %d", step);
+  for (uint32_t i = 0; i < total && i < 4; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  MXPredFree(h);
+  return 0;
+}
+"""
+
+
+def test_c_predict_partial_out_and_ndlist(tmp_path):
+    """MXPredCreatePartialOut + MXPredPartialForward + MXNDList*: feature
+    extraction and step-wise execution through the pure-C ABI, against
+    Python oracles."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 6))],
+             label_shapes=[("softmax_label", (2,))])
+    mx.random.seed(4)
+    mod.init_params(initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 0)
+
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    shim = str(tmp_path / "libmxtpu_predict.so")
+    r = subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC",
+         os.path.join(_ROOT, "mxnet_tpu", "native", "c_predict_api.cpp"),
+         "-o", shim, f"-I{inc}", f"-L{libdir}",
+         f"-lpython{sysconfig.get_python_version()}"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    src = str(tmp_path / "partial_client.c")
+    with open(src, "w") as f:
+        f.write(_C_PARTIAL_CLIENT)
+    exe = str(tmp_path / "partial_client")
+    r = subprocess.run(
+        ["gcc", "-O2", src, "-o", exe, shim, f"-Wl,-rpath,{tmp_path}",
+         f"-Wl,-rpath,{libdir}"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [exe, prefix + "-symbol.json", prefix + "-0000.params"],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr + r.stdout
+    lines = r.stdout.strip().splitlines()
+    ndl = [l for l in lines if l.startswith("NDLIST")][0].split()
+    assert int(ndl[1]) == 2  # fc weight + bias entries
+    feat = [l for l in lines if l.startswith("FEAT")][0].split()[1:]
+    got = np.array([float(x) for x in feat], np.float32).reshape(2, 4)
+
+    # python oracle: the fc features (pre-softmax)
+    x = (0.1 * np.arange(12, dtype=np.float32)).reshape(2, 6)
+    feats = fc
+    fexe = feats.simple_bind(mx.cpu(), grad_req="null", data=(2, 6))
+    args, auxs = mod.get_params()
+    fexe.copy_params_from(args, auxs)
+    fexe.arg_dict["data"][:] = x
+    expect = fexe.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(got, expect, rtol=1e-4, atol=1e-5)
+
+    stepped = [l for l in lines if l.startswith("STEPPED")][0].split()
+    got_step = np.array([float(v) for v in stepped[2:]], np.float32)
+    assert_almost_equal(got_step, expect.ravel()[:4], rtol=1e-4, atol=1e-5)

@@ -406,6 +406,7 @@ def test_grouped_stages_with_batchnorm_aux():
     seq.forward(batch, is_train=True)
     _, auxs = seq.get_params()
     all_means = [n for n in auxs if "moving_mean" in n]
+    assert len(all_means) == 4, sorted(auxs)
     moved = [n for n in all_means
              if np.abs(auxs[n].asnumpy()).max() > 1e-8]
     stuck = sorted(set(all_means) - set(moved))
