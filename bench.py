@@ -5,6 +5,14 @@ Baseline: the reference's published ResNet-50 train throughput on its best
 single GPU (P100, 181.53 img/s @ bs32, docs/how_to/perf.md:179-188 — see
 BASELINE.md). Methodology mirrors ``train_imagenet.py --benchmark 1``:
 synthetic data, train-mode forward+backward+update, steady-state timing.
+
+Steps are dispatched through ``Module.train_window`` (K fused steps per
+XLA program, default K=20 on TPU; BENCH_FUSED_STEPS=1 restores per-step
+dispatch) — the framework's intended steady-state training loop. Every
+window iteration is a full fwd+bwd+update on the synthetic batch, exactly
+like the reference's benchmark loop; the window only removes per-step
+host dispatch, which on a tunneled chip costs a serialized ~3 ms round
+trip that the reference's threaded engine would likewise pipeline away.
 """
 
 import json
@@ -24,8 +32,10 @@ def main():
     on_tpu = jax.devices()[0].platform != "cpu"
     batch_size = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16" if on_tpu else "float32")
+    fused = max(1, int(os.environ.get("BENCH_FUSED_STEPS", 20 if on_tpu else 1)))
     warmup = 5 if on_tpu else 2
     iters = int(os.environ.get("BENCH_ITERS", 25 if on_tpu else 3))
+    # iters counts STEPS; dispatches per timed window = ceil(iters/fused)
     windows = max(1, int(os.environ.get("BENCH_WINDOWS", 4 if on_tpu else 1)))
     num_layers = int(os.environ.get("BENCH_LAYERS", 50))
     image = (3, 224, 224) if on_tpu else (3, 64, 64)
@@ -52,9 +62,17 @@ def main():
     label = mx.nd.array(rng.randint(0, 1000, (batch_size,)).astype(np.float32))
     batch = mx.io.DataBatch(data=[data], label=[label])
 
-    def step():
-        mod.forward_backward(batch)
-        mod.update()
+    def run_steps(n):
+        # n train steps, dispatched as training windows of `fused` steps
+        done = 0
+        while done < n:
+            k = min(fused, n - done)
+            if k > 1:
+                mod.train_window(batch, k)
+            else:
+                mod.forward_backward(batch)
+                mod.update()
+            done += k
 
     def fence():
         # a device->host fetch is the only true execution barrier on every
@@ -63,18 +81,21 @@ def main():
         # on the whole step chain, so one scalar fetch fences everything
         np.asarray(mod.get_outputs()[0]._data[0, :1])
 
-    for _ in range(warmup):
-        step()
+    # warmup in whole windows too: a trailing partial window would compile
+    # an extra program shape the timed region never uses
+    run_steps(((max(warmup, 2 * fused) + fused - 1) // fused) * fused)
     fence()
 
     # several independently-timed windows: the reported value is the
     # median window, and the spread (max-min)/median is emitted so a
     # noisy tunnel/host can't silently swing the headline number
+    # round steps up to whole windows: a partial window would compile a
+    # second program shape for no measurement benefit
+    iters = ((max(iters, fused) + fused - 1) // fused) * fused
     rates = []
     for _ in range(windows):
         tic = time.time()
-        for _ in range(iters):
-            step()
+        run_steps(iters)
         fence()
         rates.append(batch_size * iters / (time.time() - tic))
     import statistics

@@ -308,7 +308,7 @@ class DataParallelExecutorGroup:
     def has_pending_backward(self):
         return getattr(self._exec, "_bwd_scheduled", False)
 
-    def update_fused(self, optimizer, updater):
+    def update_fused(self, optimizer, updater, n_steps=1, data_stacks=None):
         """Apply the optimizer inside the executor's jitted train step.
 
         TPU replacement for the reference's per-parameter ``Updater`` loop
@@ -386,12 +386,19 @@ class DataParallelExecutorGroup:
         keys = host["keys"]
         names = host["names"]
         nd_leaves = host["nd_leaves"]
+        # lr/wd/t are the FIRST step's values (the program advances t
+        # on-device each iteration; lr/wd stay frozen for the window), so
+        # read them after one count advance, then land the host count on
+        # the window-end value
         for i in keys:
             optimizer._update_count(i)
         iuc = optimizer._index_update_count
         lrs = [optimizer._get_lr(i) for i in keys]
         wds = [optimizer._get_wd(i) for i in keys]
         ts = [iuc[i] for i in keys]
+        for _ in range(n_steps - 1):
+            for i in keys:
+                optimizer._update_count(i)
 
         try:
             # handles protocol: the executor extracts leaf values itself so
@@ -401,13 +408,14 @@ class DataParallelExecutorGroup:
                 names, host["apply_fn"],
                 (None, host["state_td"], nd_leaves),
                 lrs, wds, ts, cache_token=opt_token,
+                n_steps=n_steps, data_stacks=data_stacks,
             )
         except Exception as e:
             # roll back the update counts so a retried/fallback update sees
             # the right t and lr schedule (valid for trace/compile failures,
             # where donation never happened)
             for i in keys:
-                optimizer._index_update_count[i] -= 1
+                optimizer._index_update_count[i] -= n_steps
             optimizer.num_update = max(
                 [optimizer.begin_num_update]
                 + list(optimizer._index_update_count.values())

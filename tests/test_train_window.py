@@ -1,0 +1,343 @@
+"""Training-window tests: K fused steps in one program == K serial steps.
+
+The window is the TPU answer to dispatch-bound training loops (the
+reference's engine pipelines per-op pushes asynchronously,
+``src/engine/threaded_engine.cc``; a jit boundary can't pipeline across
+executes on dispatch-latency-bound runtimes, so the window moves the loop
+INTO the program — see ``Executor.fused_train_update`` ``n_steps``).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _sym():
+    d = mx.sym.Variable("data")
+    l = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(d, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.BatchNorm(h, name="bn1")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(h, label=l, name="softmax")
+
+
+def _module(opt="sgd", opt_params=None):
+    m = mx.mod.Module(_sym(), context=mx.cpu())
+    m.bind(data_shapes=[mx.io.DataDesc("data", (8, 32))],
+           label_shapes=[mx.io.DataDesc("softmax_label", (8,))])
+    m.init_params(initializer=mx.init.Xavier(), force_init=True)
+    m.init_optimizer(
+        optimizer=opt,
+        optimizer_params=opt_params or {"learning_rate": 0.1, "momentum": 0.9},
+    )
+    return m
+
+
+def _batches(n=4, seed=3):
+    rng = np.random.RandomState(seed)
+    return [
+        mx.io.DataBatch(
+            data=[mx.nd.array(rng.randn(8, 32))],
+            label=[mx.nd.array(rng.randint(0, 10, (8,)))],
+        )
+        for _ in range(n)
+    ]
+
+
+class _WindowSpy:
+    """Records every fused_train_update dispatch's n_steps (proves the
+    window actually ran fused rather than falling back serially)."""
+
+    def __init__(self, monkeypatch):
+        from mxnet_tpu.executor import Executor
+
+        self.calls = []
+        orig = Executor.fused_train_update
+
+        def spy(exe, *a, **kw):
+            self.calls.append(kw.get("n_steps", 1))
+            return orig(exe, *a, **kw)
+
+        monkeypatch.setattr(Executor, "fused_train_update", spy)
+
+
+def _assert_params_equal(m_ref, m_win, rtol=2e-5, atol=2e-5):
+    a1, x1 = m_ref.get_params()
+    a2, x2 = m_win.get_params()
+    for k in a1:
+        np.testing.assert_allclose(
+            a1[k].asnumpy(), a2[k].asnumpy(), rtol=rtol, atol=atol, err_msg=k
+        )
+    for k in x1:  # aux (BN moving stats) must advance per-iteration too
+        np.testing.assert_allclose(
+            x1[k].asnumpy(), x2[k].asnumpy(), rtol=rtol, atol=atol, err_msg=k
+        )
+
+
+def test_stacked_batches_window_matches_serial(monkeypatch):
+    bs = _batches(4)
+    mx.random.seed(7)
+    m_ref = _module()
+    mx.random.seed(7)
+    m_win = _module()
+    for b in bs:
+        m_ref.forward_backward(b)
+        m_ref.update()
+    spy = _WindowSpy(monkeypatch)
+    m_win.train_window(None, batches=bs)
+    assert spy.calls == [4], "window fell back to serial dispatch"
+    _assert_params_equal(m_ref, m_win)
+
+
+def test_same_batch_window_matches_serial_and_outputs(monkeypatch):
+    bs = _batches(1)
+    mx.random.seed(7)
+    m_ref = _module()
+    mx.random.seed(7)
+    m_win = _module()
+    for _ in range(5):
+        m_ref.forward_backward(bs[0])
+        m_ref.update()
+    spy = _WindowSpy(monkeypatch)
+    m_win.train_window(bs[0], n_steps=5)
+    assert spy.calls == [5], "window fell back to serial dispatch"
+    _assert_params_equal(m_ref, m_win)
+    np.testing.assert_allclose(
+        m_ref.get_outputs()[0].asnumpy(),
+        m_win.get_outputs()[0].asnumpy(), rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_window_advances_update_count_and_t():
+    bs = _batches(1)
+    mx.random.seed(7)
+    m = _module()
+    m.train_window(bs[0], n_steps=3)
+    assert m._optimizer.num_update == 3
+    # a following single step continues the count seamlessly
+    m.forward_backward(bs[0])
+    m.update()
+    assert m._optimizer.num_update == 4
+
+
+def test_window_momentum_optimizer_state_advances():
+    """Optimizer state (momentum) after a window equals serial-state."""
+    bs = _batches(3, seed=11)
+    mx.random.seed(9)
+    m_ref = _module()
+    mx.random.seed(9)
+    m_win = _module()
+    for b in bs:
+        m_ref.forward_backward(b)
+        m_ref.update()
+    m_win.train_window(None, batches=bs)
+    s_ref = m_ref._updater.states
+    s_win = m_win._updater.states
+    assert set(s_ref) == set(s_win)
+    for k in s_ref:
+        r, w = s_ref[k], s_win[k]
+        if r is None:
+            assert w is None
+            continue
+        np.testing.assert_allclose(
+            np.asarray(r.asnumpy()), np.asarray(w.asnumpy()),
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_window_falls_back_without_traceable_optimizer(monkeypatch):
+    """When the step can't run as one program the window loops serially."""
+    bs = _batches(2)
+    mx.random.seed(5)
+    m_ref = _module()
+    mx.random.seed(5)
+    m_win = _module()
+    monkeypatch.setattr(type(m_win._optimizer), "jax_apply", None)
+    monkeypatch.setattr(type(m_ref._optimizer), "jax_apply", None)
+    for b in bs:
+        m_ref.forward_backward(b)
+        m_ref.update()
+    spy = _WindowSpy(monkeypatch)
+    m_win.train_window(None, batches=bs)
+    assert spy.calls == []  # nothing fusable: pure serial fallback
+    _assert_params_equal(m_ref, m_win)
+
+
+def test_window_rng_stream_continues_into_serial_steps(monkeypatch):
+    """Stochastic ops must not replay window-consumed rng streams.
+
+    A window of 3 + 2 serial steps must consume the same per-step dropout
+    masks as 5 serial steps (the host step counter advances by the window
+    length, not by 1)."""
+    def _sym_do():
+        d = mx.sym.Variable("data")
+        l = mx.sym.Variable("softmax_label")
+        h = mx.sym.FullyConnected(d, num_hidden=32, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.Dropout(h, p=0.5, name="do1")
+        h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+        return mx.sym.SoftmaxOutput(h, label=l, name="softmax")
+
+    def _make():
+        m = mx.mod.Module(_sym_do(), context=mx.cpu())
+        m.bind(data_shapes=[mx.io.DataDesc("data", (8, 32))],
+               label_shapes=[mx.io.DataDesc("softmax_label", (8,))])
+        m.init_params(initializer=mx.init.Xavier(), force_init=True)
+        m.init_optimizer(optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1})
+        return m
+
+    b = _batches(1)[0]
+    mx.random.seed(13)
+    m_ref = _make()
+    for _ in range(5):
+        m_ref.forward_backward(b)
+        m_ref.update()
+    mx.random.seed(13)
+    m_win = _make()
+    spy = _WindowSpy(monkeypatch)
+    m_win.train_window(b, n_steps=3)
+    for _ in range(2):
+        m_win.forward_backward(b)
+        m_win.update()
+    assert spy.calls[0] == 3
+    _assert_params_equal(m_ref, m_win)
+
+
+def test_window_stacks_cast_to_bound_dtype(monkeypatch):
+    """f32 batches fed to a bf16-bound module follow _bind_inputs' cast:
+    the window trains the same trajectory as serial steps."""
+    def _make():
+        m = mx.mod.Module(_sym(), context=mx.cpu())
+        m.bind(data_shapes=[mx.io.DataDesc("data", (8, 32), "bfloat16")],
+               label_shapes=[mx.io.DataDesc("softmax_label", (8,))])
+        m.init_params(initializer=mx.init.Xavier(), force_init=True)
+        m.init_optimizer(optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1})
+        return m
+
+    bs = _batches(3)
+    mx.random.seed(21)
+    m_ref = _make()
+    mx.random.seed(21)
+    m_win = _make()
+    for b in bs:
+        m_ref.forward_backward(b)
+        m_ref.update()
+    spy = _WindowSpy(monkeypatch)
+    m_win.train_window(None, batches=bs)
+    assert spy.calls == [3]
+    import jax.numpy as jnp
+
+    exe = m_win._exec_group._exec
+    assert exe.arg_dict["data"]._data.dtype == jnp.bfloat16
+    _assert_params_equal(m_ref, m_win, rtol=5e-3, atol=5e-3)  # bf16 path
+
+
+def test_window_hyper_tape_starts_at_first_step(monkeypatch):
+    """The program's t tape and lr are the WINDOW-START values (t advances
+    on-device; lr is frozen for the window)."""
+    from mxnet_tpu.executor import Executor
+
+    seen = {}
+    orig = Executor.fused_train_update
+
+    def spy(exe, names, fn, states, lrs, wds, ts, **kw):
+        seen["ts"] = list(ts)
+        seen["lrs"] = list(lrs)
+        return orig(exe, names, fn, states, lrs, wds, ts, **kw)
+
+    import pytest
+
+    mp = pytest.MonkeyPatch()
+    mp.setattr(Executor, "fused_train_update", spy)
+    try:
+        mx.random.seed(2)
+        m = _module(opt_params={
+            "learning_rate": 0.4,
+            "lr_scheduler": mx.lr_scheduler.FactorScheduler(step=2,
+                                                            factor=0.5),
+        })
+        m.train_window(_batches(1)[0], n_steps=4)
+    finally:
+        mp.undo()
+    assert all(t == 1 for t in seen["ts"])  # first step of the window
+    assert all(abs(lr - 0.4) < 1e-9 for lr in seen["lrs"])  # un-decayed
+    assert m._optimizer.num_update == 4  # host count lands on window end
+
+
+def test_window_grad_add_falls_back_serial(monkeypatch):
+    """grad_req='add' modules get the documented serial fallback (no
+    mid-flight executor error)."""
+    mx.random.seed(5)
+    m = mx.mod.Module(_sym(), context=mx.cpu())
+    m.bind(data_shapes=[mx.io.DataDesc("data", (8, 32))],
+           label_shapes=[mx.io.DataDesc("softmax_label", (8,))],
+           grad_req="add")
+    m.init_params(initializer=mx.init.Xavier(), force_init=True)
+    m.init_optimizer(optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1})
+    spy = _WindowSpy(monkeypatch)
+    m.train_window(_batches(1)[0], n_steps=3)
+    assert all(k == 1 for k in spy.calls)  # serial single-step dispatches
+
+
+def test_window_unbound_label_and_empty_batches():
+    """Labels carried by batches but not bound by the symbol are dropped
+    (serial-feed semantics); an empty batches list is a no-op."""
+    import warnings
+
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=4, name="fc")
+    out = mx.sym.MakeLoss(mx.sym.sum(h * h), name="loss")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = mx.mod.Module(out, context=mx.cpu())  # default label_names
+    m.bind(data_shapes=[mx.io.DataDesc("data", (4, 8))], label_shapes=None)
+    m.init_params(force_init=True)
+    m.init_optimizer(optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.01})
+    m.train_window(None, batches=[])  # no-op, no crash
+    rng = np.random.RandomState(0)
+    bs = [mx.io.DataBatch(data=[mx.nd.array(rng.randn(4, 8))],
+                          label=[mx.nd.array(rng.randn(4,))])
+          for _ in range(3)]
+    m.train_window(None, batches=bs)  # must not raise on the stray label
+
+
+def test_window_rejects_grad_add():
+    m = mx.mod.Module(_sym(), context=mx.cpu())
+    m.bind(data_shapes=[mx.io.DataDesc("data", (8, 32))],
+           label_shapes=[mx.io.DataDesc("softmax_label", (8,))],
+           grad_req="add")
+    m.init_params(initializer=mx.init.Xavier(), force_init=True)
+    m.init_optimizer(optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1})
+    b = _batches(1)[0]
+    # schedule one backward so grads accumulate, then a window must refuse
+    m.forward_backward(b)
+    m.update()
+    m.forward(b, is_train=True)
+    m.backward()
+    with pytest.raises(mx.base.MXNetError):
+        m._exec_group.update_fused(
+            m._optimizer,
+            m._updater if not m._update_on_kvstore else m._kvstore._updater,
+            n_steps=4,
+        )
+
+
+def test_window_bad_stack_shape_rejected():
+    m = _module()
+    b = _batches(1)[0]
+    m.forward(b, is_train=True)
+    m.backward()
+    with pytest.raises(mx.base.MXNetError):
+        m._exec_group.update_fused(
+            m._optimizer,
+            m._updater if not m._update_on_kvstore else m._kvstore._updater,
+            n_steps=4,
+            data_stacks={"data": mx.nd.zeros((4, 9, 32))},
+        )
