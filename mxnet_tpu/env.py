@@ -67,6 +67,15 @@ _declare("MXNET_PACK_SMALL_PARAMS", _parse_bool, True,
          "tensors otherwise each pay an async staging copy per step. "
          "Disabled automatically under meshes/sharding, ctx-group "
          "placement and NaiveEngine.")
+_declare("MXNET_WINDOW_AUTO_LAYOUT", _parse_bool, True,
+         "Let the TPU compiler choose parameter/state buffer layouts for "
+         "training-window programs (Executor.fused_train_update n_steps>1, "
+         "single device). Kills per-iteration weight-relayout copies the "
+         "default layouts force inside the window loop (measured +2%); "
+         "boundary format conversions happen once, then donated buffers "
+         "stay in compiler-preferred formats. Single-step programs keep "
+         "default layouts (measured -3% there: per-step boundary "
+         "relayouts outweigh the win).")
 _declare("MXNET_PP_MICROBATCHES", int, 0,
          "GPipe microbatch count used when SequentialModule lowers to the "
          "pipeline schedule under a 'pp' mesh axis; 0 = the pp degree. "

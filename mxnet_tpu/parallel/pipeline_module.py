@@ -20,10 +20,13 @@ Two lowerings, picked automatically:
   slice.
 * **composed** — heterogeneous stages (the common case: distinct layers,
   loss head on the last stage): each tick dispatches this rank's stage
-  with ``lax.switch`` over per-stage branch closures. Parameters are
-  replicated over the mesh — correct, but each device holds every stage's
-  weights; stack your repeated blocks into homogeneous stages if that
-  matters.
+  with ``lax.switch`` over per-stage branch closures. Parameters and aux
+  are PACKED per stage: stage ``i``'s tensors ride row ``i`` of one
+  ``(S, Lmax)`` flat buffer per dtype, sharded ``P('pp')`` — each rank
+  holds ~1/S of the parameter bytes (padding to the longest stage), the
+  same memory scaling the stacked mode gets, without requiring
+  homogeneity. Gradients come back sharded the same way (only ``dp``
+  contributions are summed).
 
 Scope (enforced with clear errors): every child is a plain bound
 ``Module`` with one data input, interior boundaries are single tensors of
@@ -203,6 +206,109 @@ class PipelineEngine:
         self._programs = {}
         self._last_outputs = None
         self._rng_dev = None
+        if not self.homogeneous:
+            # composed-mode parameter packing: stage i's params/aux ride
+            # row i of one (S, Lmax) buffer per dtype, sharded P('pp') —
+            # heterogeneous pipelines get the same 1/S per-device
+            # parameter memory the stacked (homogeneous) mode has, instead
+            # of full replication
+            self._param_layout = self._make_pack_layout(is_aux=False)
+            self._aux_layout = self._make_pack_layout(is_aux=True)
+        # packed buffers are rebuilt from the child executors every run()
+        # (they remain the single source of truth for checkpoint/update);
+        # the repack is O(param tensors) of eager device ops per step — an
+        # accepted cost on the capability path. retain_packed=True keeps
+        # the last packed params alive for sharding introspection (tests);
+        # off by default so steady state holds no second parameter copy.
+        self.retain_packed = False
+        self._packed_params = None
+
+    def _make_pack_layout(self, is_aux):
+        """Static flat layout: per dtype, per stage, the (entry_index,
+        offset, size, shape) slices of that stage's packed row."""
+        per_stage = []
+        dtypes = set()
+        for info in self.infos:
+            entries = info.aux_entries if is_aux else info.param_entries
+            rows = {}
+            for j, (u, n) in enumerate(entries):
+                unit = info.units[u]
+                d = unit.exec_.aux_dict if is_aux else unit.exec_.arg_dict
+                arr = d[n]
+                dt = str(arr.dtype)
+                dtypes.add(dt)
+                off = rows.setdefault(dt, [0, []])
+                size = 1
+                for s in arr.shape:
+                    size *= int(s)
+                off[1].append((j, off[0], size, tuple(arr.shape)))
+                off[0] += size
+            per_stage.append(rows)
+        dtypes = sorted(dtypes)
+        lmax = {}
+        for dt in dtypes:
+            longest = max((st[dt][0] for st in per_stage if dt in st),
+                          default=0)
+            lmax[dt] = max(128, -(-longest // 128) * 128)  # lane-align
+        return {"dtypes": dtypes, "per_stage": per_stage, "lmax": lmax,
+                "n_entries": [len(info.aux_entries if is_aux
+                                  else info.param_entries)
+                              for info in self.infos]}
+
+    def _pack_rows(self, vals_per_stage, layout):
+        """Eager: stack per-stage flat rows into {dtype: (S, Lmax)} arrays
+        placed P('pp') so each pipeline rank holds only its stage's row."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        out = {}
+        for dt in layout["dtypes"]:
+            rows = []
+            for i in range(self.S):
+                sl = layout["per_stage"][i].get(dt)
+                parts = []
+                if sl is not None:
+                    vals = vals_per_stage[i]
+                    parts = [jnp.ravel(vals[j]) for j, _, _, _ in sl[1]]
+                used = sl[0] if sl is not None else 0
+                pad = layout["lmax"][dt] - used
+                if pad:
+                    parts.append(jnp.zeros((pad,), jnp.dtype(dt)))
+                rows.append(jnp.concatenate(parts) if len(parts) > 1
+                            else parts[0])
+            buf = jnp.stack(rows)
+            out[dt] = jax.device_put(
+                buf, NamedSharding(self.mesh, P("pp")))
+        return out
+
+    @staticmethod
+    def _unpack_row(stage_layout, packed_local, n_entries):
+        """Rebuild stage tensors from this rank's (1, Lmax) rows; offsets
+        are static (the stage index is static inside its switch branch)."""
+        vals = [None] * n_entries
+        for dt, (_used, sl) in stage_layout.items():
+            row = packed_local[dt][0]
+            for j, off, size, shape in sl:
+                vals[j] = row[off:off + size].reshape(shape)
+        return tuple(vals)
+
+    @staticmethod
+    def _repack_row(stage_layout, packed_local, new_vals):
+        """Inverse of _unpack_row: write updated stage tensors back into
+        fresh (1, Lmax) rows (untouched dtypes keep their rows)."""
+        import jax.numpy as jnp
+
+        out = dict(packed_local)
+        for dt, (used, sl) in stage_layout.items():
+            parts = [jnp.ravel(new_vals[j]).astype(jnp.dtype(dt))
+                     for j, _, _, _ in sl]
+            lmax = packed_local[dt].shape[1]
+            if lmax > used:
+                parts.append(jnp.zeros((lmax - used,), jnp.dtype(dt)))
+            out[dt] = (jnp.concatenate(parts) if len(parts) > 1
+                       else parts[0])[None]
+        return out
 
     # -- value plumbing ---------------------------------------------------
     def _stage_vals(self):
@@ -239,6 +345,17 @@ class PipelineEngine:
         dp = "dp" if "dp" in mesh.axis_names else None
         loss_flags = _head_loss_flags(infos[-1].graph)
         num_heads = len(infos[-1].graph.heads)
+        if not homogeneous:
+            p_layout, a_layout = self._param_layout, self._aux_layout
+            unpack, repack = self._unpack_row, self._repack_row
+
+            def stage_params(i, packed):
+                return unpack(p_layout["per_stage"][i], packed,
+                              p_layout["n_entries"][i])
+
+            def stage_aux(i, packed):
+                return unpack(a_layout["per_stage"][i], packed,
+                              a_layout["n_entries"][i])
 
         def run_stage(i, a_in, labels_mb, pvals_i, avals_i, stage_key):
             """Chain the stage's grouped children over the activation.
@@ -276,18 +393,18 @@ class PipelineEngine:
 
             def first_stage_out(a):
                 pv = (jax.tree_util.tree_map(lambda v: v[0], pvals)
-                      if homogeneous else pvals[0])
+                      if homogeneous else stage_params(0, pvals))
                 av = (jax.tree_util.tree_map(lambda v: v[0], avals)
-                      if homogeneous else avals[0])
+                      if homogeneous else stage_aux(0, avals))
                 return run_stage(0, a, (), pv, av, key0)[0][0]
 
             ring_aval = jax.eval_shape(first_stage_out, xs[0])
 
             def last_stage_outs(a, lm):
                 pv = (jax.tree_util.tree_map(lambda v: v[0], pvals)
-                      if homogeneous else pvals[S - 1])
+                      if homogeneous else stage_params(S - 1, pvals))
                 av = (jax.tree_util.tree_map(lambda v: v[0], avals)
-                      if homogeneous else avals[S - 1])
+                      if homogeneous else stage_aux(S - 1, avals))
                 return run_stage(S - 1, a, lm, pv, av, key0)[0]
 
             head_avals = jax.eval_shape(
@@ -303,7 +420,7 @@ class PipelineEngine:
                 # P('pp') aux out_spec sees the rank it expects
                 aux_all0 = (avals,)
             else:
-                aux_all0 = avals
+                aux_all0 = avals  # {dtype: (1, Lmax)} local rows
 
             def tick(carry, t):
                 buf, outs, aux_all, key = carry
@@ -336,8 +453,12 @@ class PipelineEngine:
                     # from the ring activation, so stage 0 reads `feed`
                     # from its closure and ignores the ring buffer
                     def branch(i):
+                        st_layout = a_layout["per_stage"][i]
+
                         def f(buf, feed, labels_mb, aux_all):
                             a_in = feed if i == 0 else buf
+                            p_i = stage_params(i, pvals)
+                            aux_i = stage_aux(i, aux_all)
                             if i == S - 1:
                                 # fill ticks feed the last stage garbage
                                 # whose OUTPUT is masked — but loss heads
@@ -346,37 +467,35 @@ class PipelineEngine:
                                 # reference contract), so the stage must
                                 # not execute at all on invalid ticks
                                 def taken(op):
-                                    a, lm, aux_i = op
+                                    a, lm, ax = op
                                     outs_i, aux_upd = run_stage(
-                                        i, a, lm, pvals[i], aux_i,
+                                        i, a, lm, p_i, ax,
                                         jax.random.fold_in(tick_key, i))
                                     return tuple(outs_i), aux_upd
 
                                 def skipped(op):
-                                    _, _, aux_i = op
+                                    _, _, ax = op
                                     return tuple(
                                         jnp.zeros(h.shape, h.dtype)
                                         for h in head_avals
-                                    ), aux_i
+                                    ), ax
 
                                 heads, aux_upd = jax.lax.cond(
                                     out_idx >= 0, taken, skipped,
-                                    (a_in, labels_mb, aux_all[i]))
+                                    (a_in, labels_mb, aux_i))
                                 ring = zero_ring
                             else:
                                 outs_i, aux_upd = run_stage(
-                                    i, a_in, labels_mb, pvals[i],
-                                    aux_all[i],
+                                    i, a_in, labels_mb, p_i, aux_i,
                                     jax.random.fold_in(tick_key, i))
                                 ring = outs_i[0].astype(zero_ring.dtype)
                                 heads = tuple(
                                     jnp.zeros(h.shape, h.dtype)
                                     for h in head_avals
                                 )
-                            new_aux = tuple(
-                                aux_upd if j == i else aux_all[j]
-                                for j in range(S)
-                            )
+                            # this rank's row is the only one it carries —
+                            # write the stage's updated aux back into it
+                            new_aux = repack(st_layout, aux_all, aux_upd)
                             return ring, heads, new_aux
                         return f
 
@@ -400,18 +519,9 @@ class PipelineEngine:
                 jnp.arange(M + S - 1),
             )
             outs = tuple(jax.lax.psum(o, "pp") for o in outs)
-            if not homogeneous:
-                # in composed mode rank i holds the only true aux update
-                # for stage i — select it onto every rank so the P() out
-                # spec is honest (a bare P() out would silently take one
-                # rank's copy)
-                aux_all = tuple(
-                    jax.tree_util.tree_map(
-                        lambda v: jax.lax.psum(
-                            jnp.where(s == i, v, jnp.zeros_like(v)), "pp"),
-                        aux_all[i])
-                    for i in range(S)
-                )
+            # composed aux needs no cross-rank exchange: rank i's carried
+            # (1, Lmax) rows ARE stage i's aux, and the P('pp') out spec
+            # reassembles the (S, Lmax) buffers
             return outs, aux_all
 
         def sched_train(pvals, avals, rng, xs, ls):
@@ -438,11 +548,10 @@ class PipelineEngine:
 
             grads, (outs, aux_all) = jax.grad(
                 local_loss, has_aux=True)(pvals)
-            # stacked params are pp-sharded: each rank's grad IS its slice,
-            # so only dp contributions sum; replicated (composed) params
-            # need the full cross-rank reduction
-            reduce_axes = (() if homogeneous else ("pp",)) \
-                + (("dp",) if dp else ())
+            # params are pp-sharded in BOTH modes now (stacked leading axis
+            # or packed per-stage rows): each rank's grad IS its slice, so
+            # only dp contributions sum
+            reduce_axes = ("dp",) if dp else ()
             if reduce_axes:
                 grads = jax.tree_util.tree_map(
                     lambda g: jax.lax.psum(g, reduce_axes), grads)
@@ -466,16 +575,19 @@ class PipelineEngine:
                     aux_out_spec = (jax.tree_util.tree_map(
                         lambda _: P("pp"), avals[0]),)
                 else:
+                    # packed composed: {dtype: (S, Lmax)} buffers, one row
+                    # per stage, sharded over pp
                     pv_in, av_in = pvals, avals
-                    p_spec = jax.tree_util.tree_map(lambda _: P(), pv_in)
-                    a_spec = jax.tree_util.tree_map(lambda _: P(), av_in)
+                    p_spec = jax.tree_util.tree_map(lambda _: P("pp"),
+                                                    pv_in)
+                    a_spec = jax.tree_util.tree_map(lambda _: P("pp"),
+                                                    av_in)
                     aux_out_spec = a_spec
                 x_spec = P(None, dp)
                 out_specs = (tuple(P(None, dp) for _ in range(num_heads)),
                              aux_out_spec)
                 if with_grads:
-                    # grads for stacked params stay sharded P('pp'); for
-                    # composed (replicated) params they are psum'ed inside
+                    # param grads stay sharded P('pp') in both modes
                     out_specs = out_specs + (p_spec,)
                 mapped = jax.shard_map(
                     sched_train if with_grads else sched, mesh=mesh,
@@ -522,6 +634,13 @@ class PipelineEngine:
         from ..ndarray import NDArray, array as nd_array
 
         pvals, avals = self._stage_vals()
+        if not self.homogeneous:
+            # per-stage placement: stage i's params/aux ride row i of the
+            # packed P('pp') buffers, so each pipeline rank materializes
+            # ~1/S of the parameter bytes inside the program
+            pvals = self._pack_rows(pvals, self._param_layout)
+            avals = self._pack_rows(avals, self._aux_layout)
+            self._packed_params = pvals if self.retain_packed else None
 
         def as_val(a):
             return a._data if isinstance(a, NDArray) else nd_array(a)._data
@@ -564,6 +683,8 @@ class PipelineEngine:
         return self._last_outputs
 
     def _write_grads(self, grads):
+        if isinstance(grads, dict):  # packed composed {dtype: (S, Lmax)}
+            grads = self._unpack_all(grads, self._param_layout)
         for info, g in zip(self.infos, grads):
             for (u, n), gv in zip(info.param_entries, g):
                 arr = info.units[u].exec_.grad_dict.get(n)
@@ -571,9 +692,20 @@ class PipelineEngine:
                     arr._data = gv.astype(arr._data.dtype)
 
     def _write_aux(self, aux_back):
+        if isinstance(aux_back, dict):  # packed composed
+            aux_back = self._unpack_all(aux_back, self._aux_layout)
         for info, av in zip(self.infos, aux_back):
             for (u, n), v in zip(info.aux_entries, av):
                 info.units[u].exec_.aux_dict[n]._data = v
+
+    def _unpack_all(self, packed, layout):
+        """Host-side inverse of _pack_rows: per-stage value tuples."""
+        out = []
+        for i in range(self.S):
+            local = {dt: packed[dt][i][None] for dt in packed}
+            out.append(self._unpack_row(layout["per_stage"][i], local,
+                                        layout["n_entries"][i]))
+        return tuple(out)
 
     @property
     def outputs(self):
