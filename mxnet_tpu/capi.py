@@ -579,3 +579,67 @@ def nd_get_grad(nd):
     if g is None:
         raise _E("NDArray has no gradient buffer (mark_variables first)")
     return g
+# --- introspection tier (appended to mxnet_tpu/capi.py) ---------------
+
+
+def sym_get_internals(sym):
+    """``MXSymbolGetInternals`` (reference c_api.h:898): a grouped symbol
+    over every internal output."""
+    return sym.get_internals()
+
+
+def sym_get_output(sym, index):
+    """``MXSymbolGetOutput`` (reference c_api.h:915)."""
+    return sym[int(index)]
+
+
+def sym_num_outputs(sym):
+    return len(sym.list_outputs())
+
+
+def sym_infer_type(sym, keys, codes):
+    """``MXSymbolInferType`` (reference c_api.h:1055): known arg dtypes in,
+    (arg, out, aux) dtype code lists + complete flag out."""
+    kwargs = {
+        k: _DTYPE_FROM_CODE[int(c)] for k, c in zip(keys, codes)
+        if int(c) != -1
+    }
+    arg_t, out_t, aux_t = sym.infer_type(**kwargs)
+    if arg_t is None:
+        return [], [], [], 0
+
+    def enc(ts):
+        return [int(_CODE_FROM_DTYPE[np.dtype(t).name]) for t in ts]
+
+    return enc(arg_t), enc(out_t), enc(aux_t), 1
+
+
+def sym_save_file(sym, fname):
+    """``MXSymbolSaveToFile`` (reference c_api.h:783)."""
+    sym.save(fname)
+
+
+def exec_set_monitor(exe, callback, monitor_all):
+    """``MXExecutorSetMonitorCallback`` (reference c_api.h:1269): per-op
+    output stat callback; a None callback uninstalls. The C trampoline
+    receives (name, NDArray-handle) per monitored value."""
+    if callback is None:
+        exe.set_monitor_callback(None)
+        return
+    exe.set_monitor_callback(lambda name, arr: callback(name, arr),
+                             monitor_all=bool(monitor_all))
+
+
+def random_seed(seed):
+    """``MXRandomSeed`` (reference c_api.h:168)."""
+    from . import random as _random
+
+    _random.seed(int(seed))
+
+
+def notify_shutdown():
+    """``MXNotifyShutdown`` (reference c_api.h:176): drain in-flight work
+    so the process can unload the library safely."""
+    from . import engine as _engine
+
+    _engine.get().wait_for_all()

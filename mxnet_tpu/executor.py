@@ -178,13 +178,16 @@ class _CompiledGraph:
         self.num_rng_ops = serial
 
     def evaluate(self, arg_vals, aux_vals, rng, is_train, monitor=None,
-                 limit=None):
+                 limit=None, monitor_all=False):
         """Run the graph. Returns (head_outputs, aux_updates_list).
 
         With ``limit`` set, interprets only the first ``limit`` op nodes and
         returns that prefix's last outputs instead of the heads — the
         PartialForward debug contract (one interpreter serves both paths so
-        placement/remat/rng handling can never diverge)."""
+        placement/remat/rng handling can never diverge). ``monitor_all``
+        additionally reports every VARIABLE value (weights/data/aux) under
+        its own name — the reference's SetMonitorCallbackEX input
+        monitoring (op outputs already cover all interior edges)."""
         import jax
 
         env = {}
@@ -197,6 +200,8 @@ class _CompiledGraph:
                     env[id(node)] = [aux_vals[self._aux_index[node.name]]]
                 else:
                     env[id(node)] = [arg_vals[self._arg_index[node.name]]]
+                if monitor is not None and monitor_all:
+                    monitor(node.name, env[id(node)][0])
                 continue
             if limit is not None and executed >= limit:
                 break
@@ -835,6 +840,7 @@ class Executor:
                     jax.random.fold_in(rng[0], int(rng[1])),
                     is_train,
                     monitor=self._monitor_callback,
+                    monitor_all=getattr(self, "_monitor_all", False),
                 )
             # re-pack the interpreter's full aux list (same split as the
             # jitted path)
@@ -1374,26 +1380,25 @@ class Executor:
                     if auto_layout:
                         # AUTO rejects concrete arrays (their layouts are
                         # already pinned): lower from avals, then convert
-                        # the first call's buffers to the chosen formats
-                        lower_args = jax.tree_util.tree_map(
-                            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype),
-                            call_args,
-                        )
-                    else:
-                        lower_args = call_args
-                    aot[0] = fn.lower(*lower_args).compile()
-                    if auto_layout:
-                        try:  # remember the compiler-chosen input formats
+                        # the first call's buffers to the chosen formats.
+                        # Any failure of the AUTO lowering/compile or of
+                        # the format introspection abandons AUTO — the
+                        # window must train, just without the layout win.
+                        try:
+                            lower_args = jax.tree_util.tree_map(
+                                lambda v: jax.ShapeDtypeStruct(
+                                    v.shape, v.dtype),
+                                call_args,
+                            )
+                            aot[0] = fn.lower(*lower_args).compile()
                             aot[1] = jax.tree_util.tree_leaves(
                                 aot[0].input_formats
                             )
                         except Exception:
-                            # without the chosen formats the boundary
-                            # conversions can't run and the AUTO-compiled
-                            # executable would reject default-layout
-                            # buffers — abandon AUTO and recompile with
-                            # default layouts (concrete args pin both
-                            # placement and layout)
+                            # without the executable+formats pair the
+                            # boundary conversions can't run — recompile
+                            # with default layouts (concrete args pin
+                            # both placement and layout)
                             aot[1] = None
                             plain = jax.jit(
                                 fn.__wrapped__,
@@ -1403,6 +1408,8 @@ class Executor:
                                 ),
                             )
                             aot[0] = plain.lower(*call_args).compile()
+                    else:
+                        aot[0] = fn.lower(*call_args).compile()
                 if aot[1] is not None:
                     # donated steady-state buffers already carry the
                     # compiled formats (they are last window's outputs);
@@ -1533,6 +1540,7 @@ class Executor:
             callback(name, NDArray(arr))
 
         self._monitor_callback = _cb if callback is not None else None
+        self._monitor_all = bool(monitor_all) and callback is not None
 
     def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
         for name, arr in arg_params.items():

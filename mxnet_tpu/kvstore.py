@@ -351,6 +351,18 @@ def create(name="local"):
     """Create a KVStore (reference ``mx.kv.create``, kvstore.cc:16-44)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
+    # reference kvstore.cc rejects unknown type strings (LOG(FATAL)
+    # "Unknown KVStore type"); same set accepted here
+    known = {
+        "local", "local_update_cpu", "local_allreduce_cpu",
+        "local_allreduce_device", "device", "nccl",
+        "dist_sync", "dist_sync_device", "dist_device_sync",
+        "dist_async", "dist_device_async",
+    }
+    if name not in known:
+        raise ValueError(
+            f"Unknown KVStore type '{name}' (accepted: {sorted(known)})"
+        )
     if "dist" in name and "async" in name:
         from .kvstore_async import AsyncDistKVStore
 

@@ -40,11 +40,13 @@ class Monitor:
     tensor names, ``sort`` orders the report by name.
     """
 
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
         self.interval = int(interval)
         self.stat_func = stat_func or _mean_abs
         self._name_filter = re.compile(pattern)
         self._sort = sort
+        self._monitor_all = bool(monitor_all)
         self._records = []
         self._armed = False
         self._batch = 0
@@ -52,8 +54,11 @@ class Monitor:
 
     # -- executor integration -------------------------------------------
     def install(self, exe):
-        """Hook an executor; its per-op outputs flow to this monitor."""
-        exe.set_monitor_callback(self._on_tensor)
+        """Hook an executor; its per-op outputs flow to this monitor
+        (``monitor_all`` adds weights/data/aux under their own names —
+        reference ``Monitor(..., monitor_all=True)``)."""
+        exe.set_monitor_callback(self._on_tensor,
+                                 monitor_all=self._monitor_all)
         self._executors.append(exe)
 
     def _on_tensor(self, name, arr):

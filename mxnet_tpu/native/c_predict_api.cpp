@@ -22,6 +22,8 @@ namespace {
 struct Predictor {
   PyObject* obj = nullptr;                 // mxnet_tpu.predictor.Predictor
   std::vector<uint32_t> out_shape;         // scratch for GetOutputShape
+  Predictor() { mxtpu::handle_reg(this); }
+  ~Predictor() { mxtpu::handle_unreg(this); }
 };
 
 using mxtpu::ensure_python;
@@ -52,6 +54,13 @@ typedef void* PredictorHandle;
 
 // Mirrors MXPredCreate (c_predict_api.h): input shapes arrive as a CSR-style
 // (indptr, flat dims) pair per input key.
+#define MXTPU_PRED_GUARD(h)                                       \
+  if (!mxtpu::handle_live(h)) {                                   \
+    mxtpu::g_last_error =                                         \
+        "invalid, freed, or foreign handle passed as " #h;        \
+    return -1;                                                    \
+  }
+
 int MXPredCreate(const char* symbol_json, const void* param_bytes,
                  int param_size, int dev_type, int dev_id,
                  uint32_t num_input_nodes, const char** input_keys,
@@ -89,6 +98,7 @@ int MXPredCreate(const char* symbol_json, const void* param_bytes,
 
 int MXPredSetInput(PredictorHandle handle, const char* key, const float* data,
                    uint32_t size) {
+  MXTPU_PRED_GUARD(handle);
   Predictor* h = static_cast<Predictor*>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* buf = PyBytes_FromStringAndSize((const char*)data,
@@ -103,6 +113,7 @@ int MXPredSetInput(PredictorHandle handle, const char* key, const float* data,
 }
 
 int MXPredForward(PredictorHandle handle) {
+  MXTPU_PRED_GUARD(handle);
   Predictor* h = static_cast<Predictor*>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* r = PyObject_CallMethod(h->obj, "forward", nullptr);
@@ -115,6 +126,7 @@ int MXPredForward(PredictorHandle handle) {
 
 int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
                          uint32_t** shape_data, uint32_t* shape_ndim) {
+  MXTPU_PRED_GUARD(handle);
   Predictor* h = static_cast<Predictor*>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* r = PyObject_CallMethod(h->obj, "get_output_shape", "I", index);
@@ -140,6 +152,7 @@ int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
 
 int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
                     uint32_t size) {
+  MXTPU_PRED_GUARD(handle);
   Predictor* h = static_cast<Predictor*>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* r = PyObject_CallMethod(h->obj, "get_output_bytes", "I", index);
@@ -164,6 +177,7 @@ int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
 }
 
 int MXPredFree(PredictorHandle handle) {
+  MXTPU_PRED_GUARD(handle);
   Predictor* h = static_cast<Predictor*>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   Py_XDECREF(h->obj);
@@ -216,6 +230,7 @@ int MXPredCreatePartialOut(const char* symbol_json, const void* param_bytes,
 }
 
 int MXPredPartialForward(PredictorHandle handle, int step, int* step_left) {
+  MXTPU_PRED_GUARD(handle);
   Predictor* h = static_cast<Predictor*>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* r = PyObject_CallMethod(h->obj, "partial_forward", "i", step);
@@ -237,6 +252,8 @@ struct NDList {
   std::vector<std::string> keys;
   std::vector<std::vector<float>> data;
   std::vector<std::vector<uint32_t>> shapes;
+  NDList() { mxtpu::handle_reg(this); }
+  ~NDList() { mxtpu::handle_unreg(this); }
 };
 }  // namespace
 
@@ -307,6 +324,7 @@ int MXNDListCreate(const char* nd_file_bytes, int nd_file_size,
 int MXNDListGet(NDListHandle handle, uint32_t index, const char** out_key,
                 const float** out_data, const uint32_t** out_shape,
                 uint32_t* out_ndim) {
+  MXTPU_PRED_GUARD(handle);
   NDList* list = static_cast<NDList*>(handle);
   if (index >= list->keys.size()) {
     g_last_error = "NDList index out of range";
@@ -320,6 +338,7 @@ int MXNDListGet(NDListHandle handle, uint32_t index, const char** out_key,
 }
 
 int MXNDListFree(NDListHandle handle) {
+  MXTPU_PRED_GUARD(handle);
   delete static_cast<NDList*>(handle);
   return 0;
 }

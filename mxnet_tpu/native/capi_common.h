@@ -18,6 +18,7 @@
 #include <cstring>
 #include <mutex>
 #include <string>
+#include <unordered_set>
 #include <vector>
 
 namespace mxtpu {
@@ -68,6 +69,39 @@ struct GIL {
   GIL() : state(PyGILState_Ensure()) {}
   ~GIL() { PyGILState_Release(state); }
 };
+
+// Live-handle registry: the reference's ABI contract is "every call
+// returns -1 with MXGetLastError set, never crashes" (c_api_common.h
+// API_BEGIN/API_END). A freed or garbage handle would otherwise be
+// dereferenced as a PyObject* — guaranteed memory corruption inside the
+// embedded interpreter. Every handle struct registers itself at
+// construction and unregisters at destruction; shim entry points reject
+// pointers the registry doesn't know.
+inline std::mutex& handle_mu() {
+  static std::mutex m;
+  return m;
+}
+
+inline std::unordered_set<const void*>& live_handles() {
+  static std::unordered_set<const void*> s;
+  return s;
+}
+
+inline void handle_reg(const void* h) {
+  std::lock_guard<std::mutex> lk(handle_mu());
+  live_handles().insert(h);
+}
+
+inline void handle_unreg(const void* h) {
+  std::lock_guard<std::mutex> lk(handle_mu());
+  live_handles().erase(h);
+}
+
+inline bool handle_live(const void* h) {
+  if (h == nullptr) return false;
+  std::lock_guard<std::mutex> lk(handle_mu());
+  return live_handles().count(h) != 0;
+}
 
 }  // namespace mxtpu
 

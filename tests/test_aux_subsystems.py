@@ -248,6 +248,26 @@ def test_profiler_dump_writes_chrome_trace(tmp_path):
 # --------------------------------------------------------------------------
 # monitor
 # --------------------------------------------------------------------------
+def test_monitor_all_reports_variables():
+    """monitor_all=True additionally streams weights/data/aux through the
+    callback during the pass itself (reference SetMonitorCallbackEX)."""
+    seen = []
+    sym = _mlp()
+    exe = sym.simple_bind(mx.cpu(), data=(2, 6), softmax_label=(2,))
+    for n, a in exe.arg_dict.items():
+        if n not in ("data", "softmax_label"):
+            a[:] = mx.nd.ones(a.shape) * 0.1
+    exe.set_monitor_callback(lambda n, a: seen.append(n), monitor_all=True)
+    exe.forward(is_train=True)
+    assert "fc1_weight" in seen and "data" in seen  # inputs reported
+    assert any(n.endswith("_output") for n in seen)
+    seen.clear()
+    exe.set_monitor_callback(lambda n, a: seen.append(n), monitor_all=False)
+    exe.forward(is_train=True)
+    assert "fc1_weight" not in seen  # outputs only without monitor_all
+    assert any(n.endswith("_output") for n in seen)
+
+
 def test_monitor_collects_stats():
     mon = mx.monitor.Monitor(interval=1, pattern=".*fc1.*")
     sym = _mlp()
