@@ -75,6 +75,13 @@ def _load():
             ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ]
+        import ctypes as _ct
+
+        lib.mxio_pack_list.restype = _ct.c_int64
+        lib.mxio_pack_list.argtypes = [
+            _ct.c_char_p, _ct.c_char_p, _ct.c_char_p, _ct.c_char_p,
+            _ct.c_int, _ct.c_int, _ct.c_int,
+        ]
         _lib = lib
         return _lib
 
@@ -148,3 +155,34 @@ def load_batch(path, offsets, data_shape, resize=-1, rand_crop=False,
     if ok < 0:
         raise OSError(f"native load_batch failed for {path}")
     return data, labels, int(ok)
+
+
+
+def pack_list(list_path, root, rec_path, idx_path=None, num_threads=0,
+              resize=0, quality=-1):
+    """Native im2rec pack: .lst -> .rec (+ .idx) via the C++ plane.
+
+    The reference ships a C++ packer (``tools/im2rec.cc``) because packing
+    a dataset through python costs hours of wall clock; this is its
+    TPU-build equivalent. ``resize<=0 and quality<0`` packs raw file bytes
+    (byte-identical to ``tools/im2rec.py --pass-through``); otherwise JPEG
+    decode -> shorter-edge bilinear resize -> re-encode at ``quality``.
+    Returns the packed record count; raises when the plane is unavailable
+    or the pack fails.
+    """
+    import ctypes as _ct
+    import os as _os
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native io plane unavailable (build failed?)")
+    if num_threads <= 0:
+        num_threads = min(16, _os.cpu_count() or 1)
+    n = lib.mxio_pack_list(
+        list_path.encode(), (root or "").encode(), rec_path.encode(),
+        (idx_path or "").encode(), _ct.c_int(num_threads),
+        _ct.c_int(resize), _ct.c_int(quality),
+    )
+    if n < 0:
+        raise RuntimeError(f"native pack failed for {list_path}")
+    return int(n)

@@ -474,6 +474,9 @@ int MXSymbolFree(SymbolHandle symbol) {
 
 static int sym_list_impl(SymbolHandle symbol, const char* which,
                          uint32_t* out_size, const char*** out_str_array) {
+  MXTPU_GUARD_HANDLE(symbol);
+  MXTPU_GUARD_PTR(out_size);
+  MXTPU_GUARD_PTR(out_str_array);
   MXTPU_API_BEGIN();
   PyObject* r = capi_call(
       "sym_list", Py_BuildValue("(Os)", H(symbol)->obj, which));
@@ -756,6 +759,7 @@ int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
                        NDArrayHandle** outputs, int num_params,
                        const char** param_keys, const char** param_vals) {
   MXTPU_GUARD_PTR(outputs);
+  MXTPU_GUARD_PTR(num_outputs);
   MXTPU_GUARD_HANDLE_ARRAY(inputs, num_inputs > 0 ? num_inputs : 0);
   MXTPU_API_BEGIN();
   if (!mxtpu::ensure_op_table()) break;
@@ -961,6 +965,8 @@ int MXKVStorePull(KVStoreHandle handle, uint32_t num, const int* keys,
 }
 
 static int kv_get_int(KVStoreHandle handle, const char* fn, int* out) {
+  MXTPU_GUARD_HANDLE(handle);
+  MXTPU_GUARD_PTR(out);
   MXTPU_API_BEGIN();
   PyObject* r = capi_call(fn, Py_BuildValue("(O)", H(handle)->obj));
   if (!r) break;
@@ -1195,6 +1201,8 @@ int MXDataIterBeforeFirst(DataIterHandle handle) {
 
 static int batch_part(DataIterHandle handle, const char* fn,
                       NDArrayHandle* out) {
+  MXTPU_GUARD_HANDLE(handle);
+  MXTPU_GUARD_PTR(out);
   MXTPU_API_BEGIN();
   if (!H(handle)->obj2) {
     g_last_error = "no current batch; call MXDataIterNext first";
@@ -1333,6 +1341,11 @@ int MXExecutorSimpleBind(
   MXTPU_GUARD_HANDLE(symbol_handle);
   MXTPU_GUARD_OPT_HANDLE(shared_exec_handle);
   MXTPU_GUARD_PTR(out);
+  MXTPU_GUARD_PTR(num_in_args);
+  MXTPU_GUARD_PTR(in_args);
+  MXTPU_GUARD_PTR(arg_grads);
+  MXTPU_GUARD_PTR(num_aux_states);
+  MXTPU_GUARD_PTR(aux_states);
   MXTPU_API_BEGIN();
   (void)provided_arg_stype_names;
   (void)shared_arg_name_list;

@@ -22,7 +22,7 @@ namespace {
 struct Predictor {
   PyObject* obj = nullptr;                 // mxnet_tpu.predictor.Predictor
   std::vector<uint32_t> out_shape;         // scratch for GetOutputShape
-  Predictor() { mxtpu::handle_reg(this); }
+  Predictor() { mxtpu::handle_reg(this, mxtpu::kHandlePredictor); }
   ~Predictor() { mxtpu::handle_unreg(this); }
 };
 
@@ -54,12 +54,13 @@ typedef void* PredictorHandle;
 
 // Mirrors MXPredCreate (c_predict_api.h): input shapes arrive as a CSR-style
 // (indptr, flat dims) pair per input key.
-#define MXTPU_PRED_GUARD(h)                                       \
-  if (!mxtpu::handle_live(h)) {                                   \
+#define MXTPU_PRED_GUARD_KIND(h, kind)                            \
+  if (!mxtpu::handle_live(h, kind)) {                                   \
     mxtpu::g_last_error =                                         \
         "invalid, freed, or foreign handle passed as " #h;        \
     return -1;                                                    \
   }
+#define MXTPU_PRED_GUARD(h) MXTPU_PRED_GUARD_KIND(h, mxtpu::kHandlePredictor)
 
 int MXPredCreate(const char* symbol_json, const void* param_bytes,
                  int param_size, int dev_type, int dev_id,
@@ -252,7 +253,7 @@ struct NDList {
   std::vector<std::string> keys;
   std::vector<std::vector<float>> data;
   std::vector<std::vector<uint32_t>> shapes;
-  NDList() { mxtpu::handle_reg(this); }
+  NDList() { mxtpu::handle_reg(this, mxtpu::kHandleNDList); }
   ~NDList() { mxtpu::handle_unreg(this); }
 };
 }  // namespace
@@ -324,7 +325,7 @@ int MXNDListCreate(const char* nd_file_bytes, int nd_file_size,
 int MXNDListGet(NDListHandle handle, uint32_t index, const char** out_key,
                 const float** out_data, const uint32_t** out_shape,
                 uint32_t* out_ndim) {
-  MXTPU_PRED_GUARD(handle);
+  MXTPU_PRED_GUARD_KIND(handle, mxtpu::kHandleNDList);
   NDList* list = static_cast<NDList*>(handle);
   if (index >= list->keys.size()) {
     g_last_error = "NDList index out of range";
@@ -338,7 +339,7 @@ int MXNDListGet(NDListHandle handle, uint32_t index, const char** out_key,
 }
 
 int MXNDListFree(NDListHandle handle) {
-  MXTPU_PRED_GUARD(handle);
+  MXTPU_PRED_GUARD_KIND(handle, mxtpu::kHandleNDList);
   delete static_cast<NDList*>(handle);
   return 0;
 }

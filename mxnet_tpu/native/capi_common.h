@@ -18,7 +18,7 @@
 #include <cstring>
 #include <mutex>
 #include <string>
-#include <unordered_set>
+#include <unordered_map>
 #include <vector>
 
 namespace mxtpu {
@@ -82,14 +82,19 @@ inline std::mutex& handle_mu() {
   return m;
 }
 
-inline std::unordered_set<const void*>& live_handles() {
-  static std::unordered_set<const void*> s;
+// handle kinds: structs with different layouts must not be
+// cross-interpreted even when both are live (an NDList read as a
+// Predictor dereferences vector internals as a PyObject*)
+enum HandleKind { kHandleCore = 1, kHandlePredictor = 2, kHandleNDList = 3 };
+
+inline std::unordered_map<const void*, int>& live_handles() {
+  static std::unordered_map<const void*, int> s;
   return s;
 }
 
-inline void handle_reg(const void* h) {
+inline void handle_reg(const void* h, int kind = kHandleCore) {
   std::lock_guard<std::mutex> lk(handle_mu());
-  live_handles().insert(h);
+  live_handles()[h] = kind;
 }
 
 inline void handle_unreg(const void* h) {
@@ -97,10 +102,11 @@ inline void handle_unreg(const void* h) {
   live_handles().erase(h);
 }
 
-inline bool handle_live(const void* h) {
+inline bool handle_live(const void* h, int kind = kHandleCore) {
   if (h == nullptr) return false;
   std::lock_guard<std::mutex> lk(handle_mu());
-  return live_handles().count(h) != 0;
+  auto it = live_handles().find(h);
+  return it != live_handles().end() && it->second == kind;
 }
 
 }  // namespace mxtpu

@@ -15,11 +15,14 @@
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <csetjmp>
+#include <algorithm>
 #include <cmath>
 #include <random>
 #include <thread>
+#include <string>
 #include <vector>
 
 #include <jpeglib.h>
@@ -429,6 +432,110 @@ bool process_record(const unsigned char* rec, size_t len, const AugmentParams& p
 
 }  // namespace
 
+// --- im2rec pack path (appended inside io_plane.cpp, before extern "C") ---
+
+// JPEG encode (libjpeg), RGB interleaved input
+bool encode_jpeg(const unsigned char* pix, int h, int w, int quality,
+                 std::vector<unsigned char>* out) {
+  jpeg_compress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  unsigned char* mem = nullptr;
+  unsigned long mem_size = 0;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    if (mem) free(mem);
+    return false;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, &mem, &mem_size);
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  while (cinfo.next_scanline < cinfo.image_height) {
+    JSAMPROW row =
+        const_cast<unsigned char*>(pix + size_t(cinfo.next_scanline) * w * 3);
+    jpeg_write_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+  out->assign(mem, mem + mem_size);
+  free(mem);
+  return true;
+}
+
+struct PackEntry {
+  uint64_t idx;
+  std::vector<float> labels;
+  std::string path;
+};
+
+// payload = IRHeader (<I f Q Q>: flag, label, id, id2) [+ label floats]
+// + image bytes — byte-for-byte the python recordio.pack() layout
+void build_payload(const PackEntry& e, const unsigned char* img, size_t len,
+                   std::string* out) {
+  uint32_t flag = e.labels.size() == 1 ? 0u : (uint32_t)e.labels.size();
+  float label = e.labels.size() == 1 ? e.labels[0] : 0.0f;
+  uint64_t id = e.idx, id2 = 0;
+  out->clear();
+  out->reserve(24 + 4 * e.labels.size() + len);
+  out->append(reinterpret_cast<const char*>(&flag), 4);
+  out->append(reinterpret_cast<const char*>(&label), 4);
+  out->append(reinterpret_cast<const char*>(&id), 8);
+  out->append(reinterpret_cast<const char*>(&id2), 8);
+  if (flag)
+    out->append(reinterpret_cast<const char*>(e.labels.data()),
+                4 * e.labels.size());
+  out->append(reinterpret_cast<const char*>(img), len);
+}
+
+bool pack_one_entry(const PackEntry& e, const std::string& root, int resize,
+                    int quality, std::string* payload) {
+  std::string full = root.empty() ? e.path : root + "/" + e.path;
+  FILE* f = fopen(full.c_str(), "rb");
+  if (!f) return false;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<unsigned char> raw(sz);
+  if (fread(raw.data(), 1, sz, f) != (size_t)sz) {
+    fclose(f);
+    return false;
+  }
+  fclose(f);
+  if (resize <= 0 && quality < 0) {  // pass-through: raw bytes
+    build_payload(e, raw.data(), raw.size(), payload);
+    return true;
+  }
+  std::vector<unsigned char> pix;
+  int h, w;
+  if (!decode_jpeg(raw.data(), raw.size(), &pix, &h, &w)) return false;
+  std::vector<unsigned char> scratch;
+  if (resize > 0) {
+    int shorter = h < w ? h : w;
+    if (shorter != resize) {
+      float s = float(resize) / shorter;
+      int nh = h < w ? resize : int(h * s + 0.5f);
+      int nw = h < w ? int(w * s + 0.5f) : resize;
+      scratch.resize(size_t(nh) * nw * 3);
+      resize_bilinear(pix.data(), h, w, scratch.data(), nh, nw);
+      pix.swap(scratch);
+      h = nh;
+      w = nw;
+    }
+  }
+  std::vector<unsigned char> enc;
+  if (!encode_jpeg(pix.data(), h, w, quality < 0 ? 95 : quality, &enc))
+    return false;
+  build_payload(e, enc.data(), enc.size(), payload);
+  return true;
+}
+
 extern "C" {
 
 // Scan a .rec file; writes up to cap record offsets. Returns total count
@@ -561,6 +668,109 @@ int64_t mxio_load_batch(const char* path, const int64_t* offsets, int64_t n,
                           rand_crop, rand_mirror, mean, stdv, scale,
                           label_width, seed, num_threads, nullptr, data_out,
                           label_out);
+}
+
+// --- appended inside the extern "C" block of io_plane.cpp ---------------
+
+// Pack an image list (.lst: idx \t label... \t relpath) into RecordIO +
+// index — the reference's C++ packer (tools/im2rec.cc) equivalent.
+// resize<=0 && quality<0  -> pass-through (raw file bytes, byte-identical
+// to the python packer's --pass-through mode); otherwise decode JPEG,
+// shorter-edge bilinear resize, re-encode at `quality`. Workers pack in
+// parallel waves; records are written in LIST ORDER with the dmlc framing
+// (magic 0xced7230a, 4-byte alignment) and idx lines "key\toffset\n".
+// Returns packed count, or -1 on I/O error. Failed entries are skipped.
+int64_t mxio_pack_list(const char* list_path, const char* root,
+                       const char* rec_path, const char* idx_path,
+                       int num_threads, int resize, int quality) {
+  FILE* lf = fopen(list_path, "r");
+  if (!lf) return -1;
+  std::vector<PackEntry> entries;
+  std::string line;
+  for (int c = fgetc(lf); c != EOF;) {
+    // unbounded line read: detection lists carry dozens of box labels and
+    // long paths (a fixed buffer would silently split entries)
+    line.clear();
+    for (; c != EOF && c != '\n'; c = fgetc(lf)) line.push_back((char)c);
+    if (c == '\n') c = fgetc(lf);
+    // fields split by tab: idx, labels..., path (path may contain spaces)
+    std::vector<std::string> parts;
+    size_t start = 0;
+    while (start <= line.size()) {
+      size_t tab = line.find('\t', start);
+      if (tab == std::string::npos) tab = line.size();
+      if (tab > start) parts.emplace_back(line.substr(start, tab - start));
+      start = tab + 1;
+    }
+    if (parts.size() < 3) continue;
+    PackEntry e;
+    e.idx = strtoull(parts[0].c_str(), nullptr, 10);
+    for (size_t i = 1; i + 1 < parts.size(); ++i)
+      e.labels.push_back(strtof(parts[i].c_str(), nullptr));
+    e.path = parts.back();
+    entries.push_back(std::move(e));
+  }
+  fclose(lf);
+
+  FILE* rf = fopen(rec_path, "wb");
+  if (!rf) return -1;
+  FILE* xf = idx_path && idx_path[0] ? fopen(idx_path, "w") : nullptr;
+  if (idx_path && idx_path[0] && !xf) {
+    fclose(rf);
+    return -1;
+  }
+
+  const uint32_t kMagic = 0xced7230a;
+  int nt = num_threads > 0 ? num_threads : 1;
+  std::string rootdir = root ? root : "";
+  int64_t packed = 0;
+  int64_t offset = 0;
+  const size_t kWave = 512;  // bound resident payload memory
+  std::vector<std::string> payloads;
+  std::vector<char> ok;
+  for (size_t base = 0; base < entries.size(); base += kWave) {
+    size_t n = std::min(kWave, entries.size() - base);
+    payloads.assign(n, {});
+    ok.assign(n, 0);
+    std::atomic<size_t> next{0};
+    auto worker = [&]() {
+      for (size_t i = next.fetch_add(1); i < n; i = next.fetch_add(1))
+        ok[i] = pack_one_entry(entries[base + i], rootdir, resize, quality,
+                               &payloads[i])
+                    ? 1
+                    : 0;
+    };
+    std::vector<std::thread> threads;
+    for (int t = 1; t < nt; ++t) threads.emplace_back(worker);
+    worker();
+    for (auto& th : threads) th.join();
+    for (size_t i = 0; i < n; ++i) {
+      if (!ok[i]) continue;
+      const std::string& p = payloads[i];
+      uint32_t lrec = (uint32_t)p.size();
+      bool wok = true;
+      if (xf)
+        wok = fprintf(xf, "%llu\t%lld\n",
+                      (unsigned long long)entries[base + i].idx,
+                      (long long)offset) > 0;
+      wok = wok && fwrite(&kMagic, 4, 1, rf) == 1 &&
+            fwrite(&lrec, 4, 1, rf) == 1 &&
+            fwrite(p.data(), 1, p.size(), rf) == p.size();
+      size_t pad = (4 - (p.size() & 3)) & 3;
+      const char zeros[4] = {0, 0, 0, 0};
+      if (pad) wok = wok && fwrite(zeros, 1, pad, rf) == pad;
+      if (!wok) {  // disk full / IO error: a corrupt archive must not
+        if (xf) fclose(xf);  // report success
+        fclose(rf);
+        return -1;
+      }
+      offset += 8 + (int64_t)((p.size() + 3) & ~size_t(3));
+      ++packed;
+    }
+  }
+  int xerr = xf ? (ferror(xf) | fclose(xf)) : 0;
+  if (ferror(rf) | fclose(rf) | xerr) return -1;
+  return packed;
 }
 
 }  // extern "C"

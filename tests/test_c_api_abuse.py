@@ -203,3 +203,36 @@ def test_infer_null_outs_rejected(lib):
                 None, None, ctypes.byref(ots), ctypes.byref(otd),
                 None, None, ctypes.byref(comp))
     assert lib.MXSymbolFree(sym) == 0
+
+
+def test_cross_kind_handles_rejected(lib):
+    """Handles of a DIFFERENT struct layout (predict-plane NDList /
+    Predictor vs core Handle) are rejected by kind, not just liveness."""
+    nd = _make_nd(lib)
+    # a live core handle into predict-plane entry points
+    expect_fail(lib, lib.MXPredForward, nd)
+    step = ctypes.c_int()
+    expect_fail(lib, lib.MXPredPartialForward, nd, 0, ctypes.byref(step))
+    expect_fail(lib, lib.MXNDListFree, nd)
+    expect_fail(lib, lib.MXPredFree, nd)
+    # the core handle is still live and usable afterwards
+    ndim = ctypes.c_uint32()
+    pdata = ctypes.POINTER(ctypes.c_uint32)()
+    assert lib.MXNDArrayGetShape(nd, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0
+    assert lib.MXNDArrayFree(nd) == 0
+
+
+def test_freed_symbol_list_and_iter_getters_rejected(lib):
+    sym = _make_sym(lib)
+    assert lib.MXSymbolFree(sym) == 0
+    n = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    expect_fail(lib, lib.MXSymbolListArguments, sym, ctypes.byref(n),
+                ctypes.byref(arr))
+    out = ctypes.c_void_p()
+    expect_fail(lib, lib.MXDataIterGetData, ctypes.c_void_p(0xDEADBEF0),
+                ctypes.byref(out))
+    rank = ctypes.c_int()
+    expect_fail(lib, lib.MXKVStoreGetRank, ctypes.c_void_p(0xDEADBEF0),
+                ctypes.byref(rank))

@@ -1,0 +1,148 @@
+"""Native im2rec pack path (reference tools/im2rec.cc equivalent).
+
+The reference ships a C++ packer because packing ImageNet through python
+costs hours; the TPU build packs through the native io plane
+(``mxio_pack_list``). Contract pinned here: pass-through packing is
+BYTE-IDENTICAL to the python packer (.rec and .idx), the re-encode path
+produces records the iterators read back correctly, and the native
+packer's measured throughput beats the python multiprocess packer.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import native, recordio
+
+cv2 = pytest.importorskip("cv2")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _im2rec():
+    spec = importlib.util.spec_from_file_location(
+        "im2rec", os.path.join(_ROOT, "tools", "im2rec.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["im2rec"] = mod  # Pool workers unpickle _pack_one by name
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _make_images(root, n, hw=(48, 64), seed=0):
+    rng = np.random.RandomState(seed)
+    os.makedirs(root, exist_ok=True)
+    for i in range(n):
+        img = rng.randint(0, 255, hw + (3,), np.uint8)
+        cv2.imwrite(os.path.join(root, f"img_{i:04d}.jpg"), img)
+
+
+@pytest.fixture(scope="module")
+def plane_ok():
+    if native.available() is False or native._load() is None:
+        pytest.skip("native io plane unavailable")
+
+
+def test_passthrough_pack_byte_identical(tmp_path, plane_ok):
+    root = str(tmp_path / "imgs")
+    _make_images(root, 24)
+    im2rec = _im2rec()
+    images = list(im2rec.list_image(root))
+    lst = str(tmp_path / "data.lst")
+    im2rec.write_list(lst, images)
+
+    # python pass-through
+    py_prefix = str(tmp_path / "py_data")
+    os.link(lst, py_prefix + ".lst")
+
+    class A:
+        resize = 0
+        quality = -1
+        color = 1
+        num_thread = 1
+
+    im2rec.im2rec(py_prefix, root, A)
+
+    nat_prefix = str(tmp_path / "nat_data")
+    n = native.pack_list(lst, root, nat_prefix + ".rec",
+                         nat_prefix + ".idx", num_threads=3,
+                         resize=0, quality=-1)
+    assert n == 24
+    with open(py_prefix + ".rec", "rb") as a, \
+            open(nat_prefix + ".rec", "rb") as b:
+        assert a.read() == b.read(), ".rec bytes differ"
+    with open(py_prefix + ".idx") as a, open(nat_prefix + ".idx") as b:
+        assert a.read() == b.read(), ".idx bytes differ"
+
+
+def test_native_reencode_pack_reads_back(tmp_path, plane_ok):
+    root = str(tmp_path / "imgs")
+    _make_images(root, 10, hw=(80, 120), seed=3)
+    im2rec = _im2rec()
+    images = [(i, f, float(i % 4)) for i, f, _l in im2rec.list_image(root)]
+    lst = str(tmp_path / "data.lst")
+    im2rec.write_list(lst, images)
+    prefix = str(tmp_path / "enc")
+    n = native.pack_list(lst, root, prefix + ".rec", prefix + ".idx",
+                         num_threads=2, resize=64, quality=85)
+    assert n == 10
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    for i, _f, lab in images:
+        hdr, img = recordio.unpack_img(rec.read_idx(i))
+        assert hdr.id == i and float(hdr.label) == lab
+        assert min(img.shape[:2]) == 64  # shorter edge resized
+    rec.close()
+    # the image iterator consumes the native-packed file end-to-end
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", data_shape=(3, 48, 48), batch_size=5,
+        shuffle=False,
+    )
+    batch = next(iter(it))
+    assert batch.data[0].shape == (5, 3, 48, 48)
+
+
+def test_native_pack_throughput_edge(tmp_path, plane_ok):
+    """Measured pack-throughput edge over the python multiprocess packer
+    (decode+resize+re-encode, 4 workers each)."""
+    root = str(tmp_path / "imgs")
+    n_img = 96
+    _make_images(root, n_img, hw=(256, 256), seed=1)
+    im2rec = _im2rec()
+    images = list(im2rec.list_image(root))
+    lst = str(tmp_path / "data.lst")
+    im2rec.write_list(lst, images)
+
+    py_prefix = str(tmp_path / "py")
+    os.link(lst, py_prefix + ".lst")
+
+    class A:
+        resize = 128
+        quality = 90
+        color = 1
+        num_thread = 4
+
+    tic = time.time()
+    im2rec.im2rec(py_prefix, root, A)
+    t_py = time.time() - tic
+
+    nat_prefix = str(tmp_path / "nat")
+    tic = time.time()
+    n = native.pack_list(lst, root, nat_prefix + ".rec",
+                         nat_prefix + ".idx", num_threads=4,
+                         resize=128, quality=90)
+    t_nat = time.time() - tic
+    assert n == n_img
+    ratio = t_py / t_nat
+    print(f"\nnative pack edge: python {n_img / t_py:.0f} img/s vs native "
+          f"{n_img / t_nat:.0f} img/s -> {ratio:.1f}x")
+    # short-burst regime (one shard): the python packer pays Pool worker
+    # spawn + per-record IPC; the native plane threads in-process. At bulk
+    # scale the two converge (~230 img/s each at 8 workers on this host,
+    # 480x360->256 q90: cv2 is C++ SIMD underneath too) — measured numbers
+    # in docs/architecture.md. Conservative CI floor:
+    assert ratio > 1.05

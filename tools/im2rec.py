@@ -59,10 +59,23 @@ def read_list(path_in):
 def _pack_one(args_tuple):
     item, root, resize, quality, color = args_tuple
     idx, fname, labels = item
-    import cv2
     import numpy as np
 
     fullpath = os.path.join(root, fname)
+    if quality < 0 and not resize:
+        # pass-through: raw file bytes, no decode/re-encode (byte-identical
+        # to the native plane's pass-through mode); unreadable entries are
+        # skipped like the decode path, never abort the whole pack
+        try:
+            with open(fullpath, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return idx, None
+        label = labels[0] if len(labels) == 1 else np.asarray(labels,
+                                                             np.float32)
+        return idx, recordio.pack(recordio.IRHeader(0, label, idx, 0), raw)
+    import cv2
+
     img = cv2.imread(fullpath, cv2.IMREAD_COLOR if color else cv2.IMREAD_GRAYSCALE)
     if img is None:
         return idx, None
@@ -118,7 +131,15 @@ def main():
     parser.add_argument("--quality", type=int, default=95)
     parser.add_argument("--color", type=int, default=1)
     parser.add_argument("--num-thread", type=int, default=1)
+    parser.add_argument("--pass-through", action="store_true",
+                        help="pack raw file bytes (no decode/re-encode)")
+    parser.add_argument("--native", action="store_true",
+                        help="pack through the C++ io plane "
+                             "(native/io_plane.cpp mxio_pack_list)")
     args = parser.parse_args()
+    if args.pass_through:
+        args.quality = -1
+        args.resize = 0
 
     if args.list:
         images = list(list_image(args.root, args.recursive))
@@ -132,6 +153,16 @@ def main():
         else:
             write_list(args.prefix + ".lst", images)
         print(f"wrote list with {len(images)} images")
+    elif args.native:
+        from mxnet_tpu import native
+
+        tic = time.time()
+        n = native.pack_list(
+            args.prefix + ".lst", args.root, args.prefix + ".rec",
+            args.prefix + ".idx", num_threads=args.num_thread,
+            resize=args.resize, quality=args.quality,
+        )
+        print(f"packed {n} images in {time.time() - tic:.1f}s (native)")
     else:
         im2rec(args.prefix, args.root, args)
 
