@@ -33,9 +33,12 @@ Scope (enforced with clear errors): every child is a plain bound
 one shared shape/dtype, and only the last child takes labels. More
 children than pipeline ranks group contiguously into balanced stages
 (each rank chains its children over the activation); fewer children than
-ranks is an error. BatchNorm-style aux states update from the final
-microbatch's tick only (per-microbatch aux updates have no serial
-meaning under GPipe).
+ranks is an error. BatchNorm-style aux states follow SERIAL semantics:
+each stage runs its M microbatch ticks against the step-start aux and
+the masked per-tick updates are averaged, which for the BN EMA equals
+one serial update with full-batch mean statistics (variances keep
+per-microbatch granularity — the reference's own non-sync multi-device
+BN behavior); fill/drain ticks contribute nothing.
 """
 
 from __future__ import annotations
@@ -294,18 +297,22 @@ class PipelineEngine:
         return tuple(vals)
 
     @staticmethod
-    def _repack_row(stage_layout, packed_local, new_vals):
+    def _repack_row(stage_layout, packed_local, new_vals, out_dtype=None):
         """Inverse of _unpack_row: write updated stage tensors back into
-        fresh (1, Lmax) rows (untouched dtypes keep their rows)."""
+        fresh (1, Lmax) rows (untouched dtypes keep their rows).
+        ``out_dtype`` overrides the storage dtype — accumulator rows must
+        receive UNQUANTIZED values (a cast through a bf16 storage dtype
+        would add M per-tick rounding errors to the average)."""
         import jax.numpy as jnp
 
         out = dict(packed_local)
         for dt, (used, sl) in stage_layout.items():
-            parts = [jnp.ravel(new_vals[j]).astype(jnp.dtype(dt))
+            cast = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(dt)
+            parts = [jnp.ravel(new_vals[j]).astype(cast)
                      for j, _, _, _ in sl]
             lmax = packed_local[dt].shape[1]
             if lmax > used:
-                parts.append(jnp.zeros((lmax - used,), jnp.dtype(dt)))
+                parts.append(jnp.zeros((lmax - used,), cast))
             out[dt] = (jnp.concatenate(parts) if len(parts) > 1
                        else parts[0])[None]
         return out
@@ -415,12 +422,25 @@ class PipelineEngine:
             zero_ring = jnp.zeros(ring_aval.shape, ring_aval.dtype)
             outs0 = tuple(jnp.zeros((M,) + tuple(h.shape), h.dtype)
                           for h in head_avals)
+            # Aux (BN moving stats) under GPipe: every tick runs its stage
+            # against the STEP-START aux and the per-tick updates are
+            # masked to the stage's M valid microbatch ticks and AVERAGED.
+            # For the EMA form upd_t = m*mv0 + (1-m)*stats_t this yields
+            # m*mv0 + (1-m)*avg_t(stats_t) — the serial update with
+            # full-batch statistics (exact for means; variances keep
+            # per-microbatch granularity, the reference's own multi-device
+            # non-sync BN semantics). Fill/drain ticks, which process ring
+            # garbage or replayed microbatches, contribute nothing.
             if homogeneous:
-                # keep the (local, size-1) stacked leading axis so the
-                # P('pp') aux out_spec sees the rank it expects
-                aux_all0 = (avals,)
+                av_base = jax.tree_util.tree_map(lambda v: v[0], avals)
+                aux_acc0 = (jax.tree_util.tree_map(
+                    lambda v: jnp.zeros(v.shape, jnp.float32), avals),)
             else:
-                aux_all0 = avals  # {dtype: (1, Lmax)} local rows
+                av_base = None  # per-branch stage_aux(i, avals)
+                aux_acc0 = {
+                    dt: jnp.zeros(avals[dt].shape, jnp.float32)
+                    for dt in avals
+                }
 
             def tick(carry, t):
                 buf, outs, aux_all, key = carry
@@ -439,15 +459,22 @@ class PipelineEngine:
                     a_in = jnp.where(s == 0, feed.astype(zero_ring.dtype),
                                      buf)
                     local_p = jax.tree_util.tree_map(lambda v: v[0], pvals)
-                    local_a = jax.tree_util.tree_map(lambda v: v[0],
-                                                     aux_all[0])
                     outs_i, aux_upd = run_stage(
-                        0, a_in, labels_mb, local_p, local_a,
+                        0, a_in, labels_mb, local_p, av_base,
                         jax.random.fold_in(tick_key, s))
                     ring = outs_i[0]
                     heads = tuple(outs_i[:num_heads])
-                    new_aux_all = (jax.tree_util.tree_map(
-                        lambda v: v[None], aux_upd),)
+                    if is_train:
+                        mb = t - s  # this rank's microbatch index at tick t
+                        aux_valid = (mb >= 0) & (mb < M)
+                        new_aux_all = (jax.tree_util.tree_map(
+                            lambda acc, u: acc + jnp.where(
+                                aux_valid, u[None].astype(jnp.float32),
+                                jnp.zeros((), jnp.float32)),
+                            aux_all[0], tuple(aux_upd),
+                        ),)
+                    else:  # eval: aux passes through bit-exact
+                        new_aux_all = aux_all
                 else:
                     # the data microbatch generally has a different shape
                     # from the ring activation, so stage 0 reads `feed`
@@ -458,7 +485,7 @@ class PipelineEngine:
                         def f(buf, feed, labels_mb, aux_all):
                             a_in = feed if i == 0 else buf
                             p_i = stage_params(i, pvals)
-                            aux_i = stage_aux(i, aux_all)
+                            aux_i = stage_aux(i, avals)  # step-start aux
                             if i == S - 1:
                                 # fill ticks feed the last stage garbage
                                 # whose OUTPUT is masked — but loss heads
@@ -493,9 +520,29 @@ class PipelineEngine:
                                     jnp.zeros(h.shape, h.dtype)
                                     for h in head_avals
                                 )
-                            # this rank's row is the only one it carries —
-                            # write the stage's updated aux back into it
-                            new_aux = repack(st_layout, aux_all, aux_upd)
+                            if not is_train:
+                                # eval BN passes aux through unchanged —
+                                # keep the carry constant so writeback is
+                                # bit-exact (no sum/divide perturbation)
+                                return ring, heads, aux_all
+                            # accumulate this tick's masked update into the
+                            # rank's f32 accumulator rows (averaged after
+                            # the scan — serial EMA semantics, see above)
+                            mb = t - i
+                            aux_valid = (mb >= 0) & (mb < M)
+                            zero_rows = {
+                                dt: jnp.zeros(aux_all[dt].shape,
+                                              jnp.float32)
+                                for dt in aux_all
+                            }
+                            contrib = repack(st_layout, zero_rows, aux_upd,
+                                             out_dtype=jnp.float32)
+                            new_aux = {
+                                dt: aux_all[dt] + jnp.where(
+                                    aux_valid, contrib[dt],
+                                    jnp.zeros((), jnp.float32))
+                                for dt in aux_all
+                            }
                             return ring, heads, new_aux
                         return f
 
@@ -514,14 +561,27 @@ class PipelineEngine:
                                        [(i, (i + 1) % S) for i in range(S)])
                 return (nxt, new_outs, new_aux_all, key), None
 
-            (_, outs, aux_all, _), _ = jax.lax.scan(
-                tick, (zero_ring, outs0, aux_all0, rng),
+            (_, outs, aux_acc, _), _ = jax.lax.scan(
+                tick, (zero_ring, outs0, aux_acc0, rng),
                 jnp.arange(M + S - 1),
             )
             outs = tuple(jax.lax.psum(o, "pp") for o in outs)
-            # composed aux needs no cross-rank exchange: rank i's carried
-            # (1, Lmax) rows ARE stage i's aux, and the P('pp') out spec
-            # reassembles the (S, Lmax) buffers
+            # average the M masked per-tick updates back into storage
+            # dtypes; no cross-rank exchange needed — rank i's rows ARE
+            # stage i's aux and the P('pp') out spec reassembles them.
+            # Eval returns the INPUT aux bit-exact (BN aux is inert there).
+            inv_m = jnp.float32(1.0 / M)
+            if not is_train:
+                aux_all = (avals,) if homogeneous else avals
+            elif homogeneous:
+                aux_all = (jax.tree_util.tree_map(
+                    lambda acc, ref: (acc * inv_m).astype(ref.dtype),
+                    aux_acc[0], avals),)
+            else:
+                aux_all = {
+                    dt: (aux_acc[dt] * inv_m).astype(avals[dt].dtype)
+                    for dt in aux_acc
+                }
             return outs, aux_all
 
         def sched_train(pvals, avals, rng, xs, ls):
