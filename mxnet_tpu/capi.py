@@ -203,23 +203,30 @@ def list_all_op_names():
     return sorted(registry._OPS.keys())
 
 
-def imperative_invoke(op_name, inputs, keys, vals, out=None):
-    """MXImperativeInvoke: run a registered op eagerly on NDArray inputs
-    with string-valued params (the path binding-generated ``mx.nd.*``
-    functions use in the reference, c_api_ndarray.cc:396-460). With
-    ``out`` (caller-provided output NDArrays, the reference's non-null
-    *outputs contract) results are written in place; otherwise returns
-    fresh output NDArrays."""
+def _imperative_fn(op_name):
     from . import ndarray
 
     fn = getattr(ndarray, op_name, None)
     if fn is None:
         raise MXNetError(f"no imperative op {op_name!r}")
-    kwargs = dict(zip(keys, vals))
+    return fn
+
+
+def _run_imperative(fn, inputs, kwargs, out):
+    """Shared out=-contract tail for MXImperativeInvoke / MXCachedInvoke:
+    with caller outputs results write in place, else fresh arrays."""
     if out is not None:
         kwargs["out"] = out if len(out) > 1 else out[0]
     res = fn(*inputs, **kwargs)
     return list(res) if isinstance(res, (list, tuple)) else [res]
+
+
+def imperative_invoke(op_name, inputs, keys, vals, out=None):
+    """MXImperativeInvoke: run a registered op eagerly on NDArray inputs
+    with string-valued params (the path binding-generated ``mx.nd.*``
+    functions use in the reference, c_api_ndarray.cc:396-460)."""
+    return _run_imperative(_imperative_fn(op_name), inputs,
+                           dict(zip(keys, vals)), out)
 
 
 class _NDView(NDArray):
@@ -643,3 +650,36 @@ def notify_shutdown():
     from . import engine as _engine
 
     _engine.get().wait_for_all()
+
+
+class _CachedOp:
+    """Pre-parsed imperative op: name + string params resolved ONCE.
+
+    ``MXCachedCreateOp`` tier (reference c_api.h:648-672,741): binding
+    generators create one cached handle per (op, attrs) and invoke it per
+    call, skipping per-call param parsing."""
+
+    __slots__ = ("op_name", "fn", "kwargs")
+
+    def __init__(self, op_name, keys, vals):
+        self.op_name = op_name
+        self.fn = _imperative_fn(op_name)
+        self.kwargs = dict(zip([str(k) for k in keys],
+                               [str(v) for v in vals]))
+
+
+def cached_create(op_name, keys, vals):
+    return _CachedOp(op_name, keys, vals)
+
+
+def cached_invoke(cop, inputs, out=None):
+    """``MXCachedInvoke``: run the cached op on NDArray inputs."""
+    return _run_imperative(cop.fn, inputs, dict(cop.kwargs), out)
+
+
+def cached_create_symbol(cop, name, args):
+    """``MXCachedCreateSymbol``: build a Symbol node from the cached op."""
+    sym = sym_create_atomic(cop.op_name, list(cop.kwargs.keys()),
+                            list(cop.kwargs.values()))
+    sym_compose(sym, name, None, list(args))
+    return sym

@@ -1802,4 +1802,112 @@ int MXNotifyShutdown() {
   MXTPU_API_END();
 }
 
+
+int MXCachedCreateOp(AtomicSymbolCreator creator, int num_inputs,
+                     int num_params, const char** param_keys,
+                     const char** param_vals, CachedOpHandle* out) {
+  MXTPU_GUARD_PTR(out);
+  (void)num_inputs;  // arity checked at invoke, like the adapter path
+  if (num_params < 0) {
+    mxtpu::g_last_error = "negative num_params";
+    return -1;
+  }
+  MXTPU_API_BEGIN();
+  if (!mxtpu::ensure_op_table()) break;
+  size_t idx = (size_t)(uintptr_t)creator;
+  if (idx == 0 || idx > mxtpu::op_table().size()) {
+    g_last_error = "invalid AtomicSymbolCreator";
+    return -1;
+  }
+  PyObject* r = capi_call(
+      "cached_create",
+      Py_BuildValue("(sNN)", mxtpu::op_table()[idx - 1].c_str(),
+                    str_list(num_params, param_keys),
+                    str_list(num_params, param_vals)));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
+int MXCachedFree(CachedOpHandle handle) {
+  MXTPU_GUARD_HANDLE(handle);
+  ensure_python();
+  delete H(handle);
+  return 0;
+}
+
+int MXCachedInvoke(CachedOpHandle handle, int num_inputs,
+                   NDArrayHandle* inputs, int* num_outputs,
+                   NDArrayHandle** outputs) {
+  MXTPU_GUARD_HANDLE(handle);
+  MXTPU_GUARD_PTR(num_outputs);
+  MXTPU_GUARD_PTR(outputs);
+  MXTPU_GUARD_HANDLE_ARRAY(inputs, num_inputs > 0 ? num_inputs : 0);
+  MXTPU_API_BEGIN();
+  PyObject* in_l = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    Py_INCREF(H(inputs[i])->obj);
+    PyList_SET_ITEM(in_l, i, H(inputs[i])->obj);
+  }
+  // caller-provided outputs (the out= contract, like MXImperativeInvoke)
+  bool caller_out = (*outputs != nullptr && *num_outputs > 0);
+  PyObject* out_l = Py_None;
+  if (caller_out) {
+    out_l = PyList_New(*num_outputs);
+    for (int i = 0; i < *num_outputs; ++i) {
+      Py_INCREF(H((*outputs)[i])->obj);
+      PyList_SET_ITEM(out_l, i, H((*outputs)[i])->obj);
+    }
+  } else {
+    Py_INCREF(Py_None);
+  }
+  PyObject* r = capi_call(
+      "cached_invoke",
+      Py_BuildValue("(ONN)", H(handle)->obj, in_l, out_l));
+  if (!r) break;
+  Py_ssize_t n = PySequence_Size(r);
+  if (caller_out) {
+    // results were written into the caller's arrays in place; no new
+    // handles to hand back (MXImperativeInvoke's out= contract)
+    Py_DECREF(r);
+    *num_outputs = (int)n;
+  } else {
+    Handle* h = H(handle);
+    h->hvec[0].clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      Handle* oh = new Handle();
+      oh->obj = PySequence_GetItem(r, i);
+      h->hvec[0].push_back(oh);
+    }
+    Py_DECREF(r);
+    *num_outputs = (int)n;
+    *outputs = h->hvec[0].data();
+  }
+  MXTPU_API_END();
+}
+
+int MXCachedCreateSymbol(CachedOpHandle handle, const char* name,
+                         uint32_t num_args, SymbolHandle* args,
+                         SymbolHandle* out) {
+  MXTPU_GUARD_HANDLE(handle);
+  MXTPU_GUARD_PTR(out);
+  MXTPU_GUARD_HANDLE_ARRAY(args, num_args);
+  MXTPU_API_BEGIN();
+  PyObject* args_l = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    Py_INCREF(H(args[i])->obj);
+    PyList_SET_ITEM(args_l, i, H(args[i])->obj);
+  }
+  PyObject* r = capi_call(
+      "cached_create_symbol",
+      Py_BuildValue("(OsN)", H(handle)->obj, name ? name : "", args_l));
+  if (!r) break;
+  Handle* h = new Handle();
+  h->obj = r;
+  *out = h;
+  MXTPU_API_END();
+}
+
 }  // extern "C"

@@ -220,6 +220,21 @@ int MXExecutorSetMonitorCallbackEX(ExecutorHandle handle,
 int MXRandomSeed(int seed);
 int MXNotifyShutdown();
 
+/* cached-op fast-invoke tier (reference c_api.h:648-672,741): one handle
+ * per (op, attrs), created once by a binding and invoked per call with
+ * param parsing already done */
+typedef void* CachedOpHandle;
+int MXCachedCreateOp(AtomicSymbolCreator creator, int num_inputs,
+                     int num_params, const char** param_keys,
+                     const char** param_vals, CachedOpHandle* out);
+int MXCachedFree(CachedOpHandle handle);
+int MXCachedInvoke(CachedOpHandle handle, int num_inputs,
+                   NDArrayHandle* inputs, int* num_outputs,
+                   NDArrayHandle** outputs);
+int MXCachedCreateSymbol(CachedOpHandle handle, const char* name,
+                         uint32_t num_args, SymbolHandle* args,
+                         SymbolHandle* out);
+
 /* ---------------- KVStore (reference c_api.h MXKVStore*) ---------------- */
 /* the per-key update callback (reference c_api.h:1482): recv is the
  * pushed gradient, local the stored weight to update in place; both

@@ -277,3 +277,85 @@ def test_python_side_introspection_capi():
     assert c2 in (0, 1)
     capi.random_seed(77)
     capi.notify_shutdown()
+
+
+def test_cached_op_tier(tmp_path):
+    """MXCachedCreateOp/Invoke/CreateSymbol/Free (reference c_api.h:648):
+    pre-parsed op handles invoke like MXImperativeInvoke and build symbol
+    nodes, matched against the python imperative path."""
+    import ctypes
+    import subprocess
+
+    out_dir = str(tmp_path / "amal")
+    r = subprocess.run(
+        ["python", os.path.join(_ROOT, "tools", "amalgamation.py"),
+         "--out-dir", out_dir],
+        capture_output=True, text=True, cwd=_ROOT,
+    )
+    assert r.returncode == 0, r.stderr
+    L = ctypes.CDLL(os.path.join(out_dir, "libmxtpu.so"))
+    L.MXGetLastError.restype = ctypes.c_char_p
+
+    # find the 'transpose' creator
+    n = ctypes.c_uint32()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    assert L.MXSymbolListAtomicSymbolCreators(
+        ctypes.byref(n), ctypes.byref(creators)) == 0
+    name = ctypes.c_char_p()
+    transpose_creator = None
+    for i in range(n.value):
+        c = ctypes.c_void_p(creators[i])
+        assert L.MXSymbolGetAtomicSymbolName(c, ctypes.byref(name)) == 0
+        if name.value == b"transpose":
+            transpose_creator = c
+    assert transpose_creator is not None
+
+    cop = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"axes")
+    vals = (ctypes.c_char_p * 1)(b"(1, 0)")
+    assert L.MXCachedCreateOp(transpose_creator, 1, 1, keys, vals,
+                              ctypes.byref(cop)) == 0, L.MXGetLastError()
+
+    # invoke on a real array; compare vs numpy transpose
+    shape = (ctypes.c_uint32 * 2)(2, 3)
+    nd = ctypes.c_void_p()
+    assert L.MXNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(nd)) == 0
+    buf = (ctypes.c_float * 6)(*range(6))
+    assert L.MXNDArraySyncCopyFromCPU(nd, buf, 6) == 0
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    ins = (ctypes.c_void_p * 1)(nd.value)
+    assert L.MXCachedInvoke(cop, 1, ins, ctypes.byref(n_out),
+                            ctypes.byref(outs)) == 0, L.MXGetLastError()
+    assert n_out.value == 1
+    got = (ctypes.c_float * 6)()
+    out_h = ctypes.c_void_p(outs[0])
+    assert L.MXNDArraySyncCopyToCPU(out_h, got, 6) == 0
+    np.testing.assert_allclose(
+        np.array(got).reshape(3, 2),
+        np.arange(6, dtype=np.float32).reshape(2, 3).T)
+
+    # symbol construction from the cached op
+    var = ctypes.c_void_p()
+    assert L.MXSymbolCreateVariable(b"x", ctypes.byref(var)) == 0
+    args = (ctypes.c_void_p * 1)(var.value)
+    sym = ctypes.c_void_p()
+    assert L.MXCachedCreateSymbol(cop, b"t0", 1, args,
+                                  ctypes.byref(sym)) == 0, L.MXGetLastError()
+    n_args = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    assert L.MXSymbolListArguments(sym, ctypes.byref(n_args),
+                                   ctypes.byref(arr)) == 0
+    assert n_args.value == 1 and arr[0] == b"x"
+
+    # error paths: bad creator + freed handle
+    bad = ctypes.c_void_p()
+    assert L.MXCachedCreateOp(ctypes.c_void_p(10**9), 0, 0, None, None,
+                              ctypes.byref(bad)) == -1
+    assert L.MXCachedFree(cop) == 0
+    assert L.MXCachedInvoke(cop, 1, ins, ctypes.byref(n_out),
+                            ctypes.byref(outs)) == -1
+    assert L.MXNDArrayFree(nd) == 0
+    assert L.MXNDArrayFree(out_h) == 0
+    assert L.MXSymbolFree(var) == 0
+    assert L.MXSymbolFree(sym) == 0

@@ -341,3 +341,27 @@ def test_window_bad_stack_shape_rejected():
             n_steps=4,
             data_stacks={"data": mx.nd.zeros((4, 9, 32))},
         )
+
+
+def test_window_checkpoint_resume_exact(tmp_path):
+    """save_checkpoint + optimizer states after windows resume EXACTLY:
+    window(3)+save / load+window(2) == window(5) trajectories."""
+    bs = _batches(1, seed=17)
+    prefix = str(tmp_path / "winck")
+    mx.random.seed(23)
+    m1 = _module()
+    m1.train_window(bs[0], n_steps=3)
+    m1.save_checkpoint(prefix, 3, save_optimizer_states=True)
+    m1.train_window(bs[0], n_steps=2)
+
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 3)
+    m2 = mx.mod.Module(sym, context=mx.cpu())
+    m2.bind(data_shapes=[mx.io.DataDesc("data", (8, 32))],
+            label_shapes=[mx.io.DataDesc("softmax_label", (8,))])
+    m2.set_params(args, auxs)
+    m2.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1,
+                                        "momentum": 0.9})
+    m2.load_optimizer_states(prefix + "-0003.states")
+    m2.train_window(bs[0], n_steps=2)
+    _assert_params_equal(m1, m2)
