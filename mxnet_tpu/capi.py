@@ -683,3 +683,11 @@ def cached_create_symbol(cop, name, args):
                             list(cop.kwargs.values()))
     sym_compose(sym, name, None, list(args))
     return sym
+
+
+def kv_num_dead_node(kv, node_id):
+    """``MXKVStoreGetNumDeadNode`` (reference kvstore_dist.h:177-185).
+    The store-side count covers the whole job (the launcher supervises
+    every rank), so the group selector is accepted and ignored."""
+    del node_id
+    return int(kv.num_dead_node)

@@ -964,6 +964,19 @@ int MXKVStorePull(KVStoreHandle handle, uint32_t num, const int* keys,
   return kv_call3(handle, "kv_pull", num, keys, vals, priority, true);
 }
 
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id,
+                            int* number) {
+  MXTPU_GUARD_HANDLE(handle);
+  MXTPU_GUARD_PTR(number);
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(
+      "kv_num_dead_node", Py_BuildValue("(Oi)", H(handle)->obj, node_id));
+  if (!r) break;
+  *number = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  MXTPU_API_END();
+}
+
 static int kv_get_int(KVStoreHandle handle, const char* fn, int* out) {
   MXTPU_GUARD_HANDLE(handle);
   MXTPU_GUARD_PTR(out);

@@ -255,6 +255,10 @@ int MXKVStoreGetRank(KVStoreHandle handle, int* out);
 int MXKVStoreGetGroupSize(KVStoreHandle handle, int* out);
 int MXKVStoreGetType(KVStoreHandle handle, const char** out);
 int MXKVStoreBarrier(KVStoreHandle handle);
+/* failure detection (reference kvstore_dist.h:177): dead nodes observed
+ * in the group containing node_id (1=scheduler 2=servers 4=workers) */
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id,
+                            int* number);
 
 /* ---------------- RecordIO (reference MXRecordIO*) ---------------- */
 int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out);

@@ -236,3 +236,16 @@ def test_freed_symbol_list_and_iter_getters_rejected(lib):
     rank = ctypes.c_int()
     expect_fail(lib, lib.MXKVStoreGetRank, ctypes.c_void_p(0xDEADBEF0),
                 ctypes.byref(rank))
+
+
+def test_kvstore_num_dead_node(lib):
+    """MXKVStoreGetNumDeadNode: live local store reports 0; freed/garbage
+    handles and NULL out reject with -1."""
+    kv = ctypes.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    n = ctypes.c_int(-1)
+    assert lib.MXKVStoreGetNumDeadNode(kv, 7, ctypes.byref(n)) == 0
+    assert n.value == 0
+    expect_fail(lib, lib.MXKVStoreGetNumDeadNode, kv, 7, None)
+    assert lib.MXKVStoreFree(kv) == 0
+    expect_fail(lib, lib.MXKVStoreGetNumDeadNode, kv, 7, ctypes.byref(n))
