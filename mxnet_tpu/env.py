@@ -35,6 +35,12 @@ _declare("MXNET_EXEC_BULK_EXEC_TRAIN", _parse_bool, True,
          "When false, disables the fused fwd+bwd+update single-program "
          "train step; the per-parameter imperative update path runs "
          "instead (reference MXNET_EXEC_BULK_EXEC_TRAIN).")
+_declare("MXNET_DEVICE_PREFETCH", _parse_bool, True,
+         "When true (default), Module.fit/score wrap the data iterator in "
+         "io.DevicePrefetchIter: a staging thread device_puts batch N+1 "
+         "with the executor's input shardings while batch N computes (the "
+         "iter_prefetcher.h analogue). Set to 0 to feed batches "
+         "synchronously from the epoch loop.")
 _declare("MXNET_PROFILER_AUTOSTART", _parse_bool, False,
          "Start the profiler at import (reference env_var.md:69-78).")
 _declare("MXNET_PROFILER_MODE", str, "symbolic",

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import gzip
 import os
+import queue as _queue
 import struct
 import threading
 from collections import namedtuple
@@ -128,10 +129,13 @@ class NDArrayIter(DataIter):
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", ctx=None):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        # device placement for produced batches (DevicePrefetchIter wiring:
+        # slices upload straight to this context instead of the default)
+        self.ctx = ctx
 
         self.idx = np.arange(self.data[0][1].shape[0])
         if shuffle:
@@ -190,12 +194,14 @@ class NDArrayIter(DataIter):
         assert self.cursor < self.num_data, "DataIter needs reset."
         if self.cursor + self.batch_size <= self.num_data:
             return [
-                array(x[1][self.cursor:self.cursor + self.batch_size])
+                array(x[1][self.cursor:self.cursor + self.batch_size],
+                      ctx=self.ctx)
                 for x in data_source
             ]
         pad = self.batch_size - self.num_data + self.cursor
         return [
-            array(np.concatenate((x[1][self.cursor:], x[1][:pad]), axis=0))
+            array(np.concatenate((x[1][self.cursor:], x[1][:pad]), axis=0),
+                  ctx=self.ctx)
             for x in data_source
         ]
 
@@ -256,9 +262,16 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Double-buffered background prefetch over one or more iterators
-    (reference ``PrefetchingIter`` / C++ ``PrefetcherIter``)."""
+    (reference ``PrefetchingIter`` / C++ ``PrefetcherIter``).
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    ``shardings``/``context`` additionally stage each prefetched batch's
+    dense arrays into device memory from the prefetch thread (the
+    ``DevicePrefetchIter`` behaviour fused into this iterator), so the H2D
+    upload also overlaps compute.
+    """
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 shardings=None, context=None):
         super().__init__()
         if not isinstance(iters, list):
             iters = [iters]
@@ -267,6 +280,10 @@ class PrefetchingIter(DataIter):
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
+        self.shardings = dict(shardings or {})
+        self._stage_device = (
+            context.jax_device() if context is not None else None
+        )
         self.batch_size = self.provide_data[0][1][0]
         self.data_ready = [threading.Event() for _ in range(self.n_iter)]
         self.data_taken = [threading.Event() for _ in range(self.n_iter)]
@@ -275,6 +292,7 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
+        self.prefetch_err = [None for _ in range(self.n_iter)]
 
         def prefetch_func(self, i):
             while True:
@@ -282,8 +300,16 @@ class PrefetchingIter(DataIter):
                 if not self.started:
                     break
                 try:
-                    self.next_batch[i] = self.iters[i].next()
+                    batch = self.iters[i].next()
+                    if self.shardings or self._stage_device is not None:
+                        batch = self._stage_batch(batch, self.iters[i])
+                    self.next_batch[i] = batch
                 except StopIteration:
+                    self.next_batch[i] = None
+                except BaseException as exc:
+                    # deliver to the consumer: dying here without setting
+                    # data_ready would hang iter_next's wait forever
+                    self.prefetch_err[i] = exc
                     self.next_batch[i] = None
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
@@ -299,6 +325,13 @@ class PrefetchingIter(DataIter):
         self.started = False
         for e in self.data_taken:
             e.set()
+
+    def _stage_batch(self, batch, it):
+        return _stage_databatch(
+            batch, self.shardings, self._stage_device,
+            batch.provide_data or it.provide_data,
+            batch.provide_label or it.provide_label,
+        )
 
     @property
     def provide_data(self):
@@ -345,6 +378,10 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         for e in self.data_ready:
             e.wait()
+        for i, exc in enumerate(self.prefetch_err):
+            if exc is not None:
+                self.prefetch_err[i] = None
+                raise exc
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iterators"
@@ -361,10 +398,197 @@ class PrefetchingIter(DataIter):
             provide_data=self.provide_data,
             provide_label=self.provide_label,
         )
+        if all(getattr(b, "staged", False) for b in self.next_batch):
+            self.current_batch.staged = True
         for e in self.data_ready:
             e.clear()
         for e in self.data_taken:
             e.set()
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+def _stage_databatch(batch, shardings, device, ddesc, ldesc):
+    """device_put a DataBatch's dense arrays (name → sharding, else
+    ``device``); sparse/lazy payloads pass through unstaged. Mutates and
+    returns ``batch``, marking it ``staged`` so consumers skip re-staging."""
+    import jax
+
+    def stage_list(arrs, descs):
+        out = []
+        for i, a in enumerate(arrs or []):
+            name = descs[i].name if descs and i < len(descs) else None
+            dst = shardings.get(name, device) if shardings else device
+            if isinstance(a, NDArray) and a._lazy is None:
+                out.append(NDArray(jax.device_put(a._data, dst)))
+            elif isinstance(a, np.ndarray):
+                out.append(NDArray(jax.device_put(a, dst)))
+            else:
+                out.append(a)
+        return out
+
+    batch.data = stage_list(batch.data, ddesc)
+    if batch.label is not None:
+        batch.label = stage_list(batch.label, ldesc)
+    batch.staged = True
+    return batch
+
+
+class _PrefetchError:
+    """Carrier for an exception raised inside the staging thread."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+_EPOCH_END = object()
+
+
+class DevicePrefetchIter(DataIter):
+    """Stage batch N+1 into device memory while batch N computes.
+
+    The TPU-native analogue of the reference's ``iter_prefetcher.h`` double
+    buffering: a background thread pulls the underlying iterator (host-side
+    slicing/decode) and ``jax.device_put``s each dense array with the
+    consumer's input shardings — by the time the train loop asks for the
+    next batch, its H2D transfer is already in flight, so upload overlaps
+    compute instead of serializing on the critical path. ``Module.fit``
+    wraps its data iterator in this automatically (``MXNET_DEVICE_PREFETCH``).
+
+    ``shardings`` maps input name → ``jax.sharding.Sharding`` (or a
+    ``jax.Device``); unknown names and non-dense payloads (e.g. CSR
+    batches) pass through unstaged. Ordering, ``pad`` and ``index`` of the
+    underlying batches are preserved exactly.
+    """
+
+    def __init__(self, data_iter, shardings=None, context=None, depth=2):
+        super().__init__(getattr(data_iter, "batch_size", 0))
+        self.data_iter = data_iter
+        self.shardings = dict(shardings or {})
+        self._device = context.jax_device() if context is not None else None
+        self.depth = max(1, int(depth))
+        self.current_batch = None
+        self._queue = None
+        self._abort = None
+        self._thread = None
+        self._exhausted = False
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    # -- staging thread ------------------------------------------------
+    def _start(self):
+        self._queue = _queue.Queue(maxsize=self.depth)
+        self._abort = threading.Event()
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._queue, self._abort), daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self, q, abort):
+        while not abort.is_set():
+            try:
+                batch = self.data_iter.next()
+            except StopIteration:
+                self._put(q, abort, _EPOCH_END)
+                return
+            except BaseException as exc:  # surface in the consumer thread
+                self._put(q, abort, _PrefetchError(exc))
+                return
+            try:
+                self._stage(batch)
+            except BaseException as exc:
+                self._put(q, abort, _PrefetchError(exc))
+                return
+            if not self._put(q, abort, batch):
+                return
+
+    @staticmethod
+    def _put(q, abort, item):
+        while not abort.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _stage(self, batch):
+        return _stage_databatch(
+            batch, self.shardings, self._device,
+            batch.provide_data or self.provide_data,
+            batch.provide_label or self.provide_label,
+        )
+
+    def _shutdown(self):
+        if self._thread is None:
+            return
+        self._abort.set()
+        while self._thread.is_alive():
+            try:
+                self._queue.get_nowait()
+            except _queue.Empty:
+                self._thread.join(timeout=0.05)
+        self._thread = None
+
+    def close(self):
+        """Stop the staging thread (the underlying iterator keeps its
+        position; call its reset() for a clean state)."""
+        self._shutdown()
+        self._queue = None
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+    # -- iterator surface ----------------------------------------------
+    def reset(self):
+        self._shutdown()
+        self.data_iter.reset()
+        self._exhausted = False
+        self._start()
+
+    def iter_next(self):
+        if self._queue is None:
+            raise MXNetError("DevicePrefetchIter used after close()")
+        if self._exhausted:
+            return False
+        item = self._queue.get()
+        if item is _EPOCH_END:
+            self.current_batch = None
+            self._exhausted = True
+            return False
+        if isinstance(item, _PrefetchError):
+            self.current_batch = None
+            self._exhausted = True
+            raise item.exc
+        self.current_batch = item
         return True
 
     def next(self):

@@ -351,8 +351,14 @@ def create(name="local"):
     """Create a KVStore (reference ``mx.kv.create``, kvstore.cc:16-44)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
-    # reference kvstore.cc rejects unknown type strings (LOG(FATAL)
-    # "Unknown KVStore type"); same set accepted here
+    # reference kvstore.cc lowercases the type, matches by substring
+    # ("dist"/"device"/"async"), and treats plain "dist" as dist_sync.
+    # Rejecting names outside the known set below is a deliberate
+    # tightening over the reference (which would silently map any string
+    # without those substrings to a local store), not reference behavior.
+    name = name.lower()
+    if name == "dist":
+        name = "dist_sync"
     known = {
         "local", "local_update_cpu", "local_allreduce_cpu",
         "local_allreduce_device", "device", "nccl",
@@ -361,7 +367,9 @@ def create(name="local"):
     }
     if name not in known:
         raise ValueError(
-            f"Unknown KVStore type '{name}' (accepted: {sorted(known)})"
+            f"Unknown KVStore type '{name}' (accepted: {sorted(known)}, "
+            "plus 'dist' as an alias for dist_sync; matching is "
+            "case-insensitive)"
         )
     if "dist" in name and "async" in name:
         from .kvstore_async import AsyncDistKVStore

@@ -4,6 +4,14 @@ Reference: ``python/mxnet/metric.py`` (1057 LoC; registry + classes at
 ``metric.py:27-936``). Metrics consume (labels, preds) NDArray lists each
 batch; ``get()`` returns (name, value). ``CompositeEvalMetric``, the
 ``np``/``CustomMetric`` wrapper, and string/list ``create`` forms are kept.
+
+Device-resident accumulation: every ``update()`` here calls ``asnumpy()``,
+which is a full device sync per batch — the reference hid that cost behind
+its threaded engine. ``device_update()`` instead accumulates the batch
+statistic as a device scalar (jax async dispatch keeps it in flight with
+the training step) and only ``get()`` syncs. Metrics without a device
+formula (``_device_batch`` returning None) fall back to the numpy path
+inside ``device_update``, so custom metrics keep working unchanged.
 """
 
 from __future__ import annotations
@@ -14,6 +22,16 @@ import numpy as _np
 
 from .base import MXNetError
 from .ndarray import NDArray
+
+
+def _dev_val(x):
+    """jax view of a label/pred (NDArray reads its handle — this dispatches
+    a scheduled forward lazily but never syncs to host)."""
+    if isinstance(x, NDArray):
+        return x._data
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)
 
 
 def check_label_shapes(labels, preds, shape=0):
@@ -41,12 +59,62 @@ class EvalMetric:
         else:
             self.num_inst = [0] * self.num
             self.sum_metric = [0.0] * self.num
+        # device-resident accumulator: a jax scalar holding the sum of all
+        # device_update contributions not yet folded into sum_metric, plus
+        # the (host-side, shape-derived) instance count that goes with it
+        self._dev_sum = None
+        self._dev_inst = 0
 
     def update(self, labels, preds):
         raise NotImplementedError()
 
+    # --- device-resident path --------------------------------------------
+    def _device_batch(self, label, pred):
+        """Per-(label, pred) device statistic: (sum, count) where ``sum`` is
+        a jax scalar and ``count`` a python int, or None when this metric
+        has no device formula (→ numpy fallback)."""
+        return None
+
+    def _device_batches(self, labels, preds):
+        check_label_shapes(labels, preds)
+        out = []
+        for label, pred in zip(labels, preds):
+            c = self._device_batch(_dev_val(label), _dev_val(pred))
+            if c is None:
+                return None
+            out.append(c)
+        return out
+
+    def device_update(self, labels, preds):
+        """Accumulate this batch on device, without a host sync.
+
+        Returns True when the device formula ran; False when the metric
+        fell back to the (synchronous) numpy ``update``.
+        """
+        if self.num is not None:
+            self.update(labels, preds)
+            return False
+        contribs = self._device_batches(labels, preds)
+        if contribs is None:
+            self._drain_device()  # keep ordering if paths interleave
+            self.update(labels, preds)
+            return False
+        for s, n in contribs:
+            self._dev_sum = s if self._dev_sum is None else self._dev_sum + s
+            self._dev_inst += n
+        return True
+
+    def _drain_device(self):
+        """Fold the device accumulator into the host sums (syncs)."""
+        if self._dev_sum is not None:
+            self.sum_metric += float(self._dev_sum)
+            self.num_inst += self._dev_inst
+            self._dev_sum = None
+            self._dev_inst = 0
+
     def get(self):
         if self.num is None:
+            self._drain_device()
             if self.num_inst == 0:
                 return (self.name, float("nan"))
             return (self.name, self.sum_metric / self.num_inst)
@@ -57,8 +125,34 @@ class EvalMetric:
         ]
         return (names, values)
 
+    def device_pending(self):
+        """True while device_update contributions are still computing on
+        device — a blocking ``get()`` now would stall the dispatch
+        pipeline, and a ``reset()`` now would discard those batches."""
+        return self._dev_sum is not None and not getattr(
+            self._dev_sum, "is_ready", lambda: True)()
+
+    def get_nonblocking(self):
+        """Like ``get()`` but never blocks on in-flight device work: if the
+        device accumulator is still computing, returns the value as of the
+        last drain (for mid-epoch progress readers; Speedometer itself
+        gates on :meth:`device_pending` so it can also defer its reset)."""
+        if self.device_pending():
+            if self.num_inst == 0:
+                return (self.name, float("nan"))
+            return (self.name, self.sum_metric / self.num_inst)
+        return self.get()
+
     def get_name_value(self):
         name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def get_name_value_nonblocking(self):
+        name, value = self.get_nonblocking()
         if not isinstance(name, list):
             name = [name]
         if not isinstance(value, list):
@@ -87,6 +181,15 @@ class CompositeEvalMetric(EvalMetric):
         for metric in self.metrics:
             metric.update(labels, preds)
 
+    def device_update(self, labels, preds):
+        ran = True
+        for metric in self.metrics:
+            ran = metric.device_update(labels, preds) and ran
+        return ran
+
+    def device_pending(self):
+        return any(m.device_pending() for m in self.metrics)
+
     def reset(self):
         try:
             for metric in self.metrics:
@@ -102,6 +205,23 @@ class CompositeEvalMetric(EvalMetric):
             names.append(result[0])
             results.append(result[1])
         return (names, results)
+
+    def get_nonblocking(self):
+        # the base implementation reads num_inst/_dev_sum, which a
+        # composite does not carry — aggregate the children instead
+        names = []
+        results = []
+        for metric in self.metrics:
+            result = metric.get_nonblocking()
+            names.append(result[0])
+            results.append(result[1])
+        return (names, results)
+
+    def get_name_value_nonblocking(self):
+        out = []
+        for metric in self.metrics:
+            out.extend(metric.get_name_value_nonblocking())
+        return out
 
 
 class Accuracy(EvalMetric):
@@ -120,6 +240,17 @@ class Accuracy(EvalMetric):
             check_label_shapes(label_np.reshape(-1), pred_np.reshape(-1))
             self.sum_metric += (pred_np.flat == label_np.flat).sum()
             self.num_inst += len(pred_np.flat)
+
+    def _device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        if pred.ndim > 1 and pred.shape[
+                -1 if self.axis == 1 and pred.ndim == 2 else self.axis] > 1:
+            pred = jnp.argmax(pred, axis=self.axis)
+        label = label.astype(jnp.int32).reshape(-1)
+        pred = pred.astype(jnp.int32).reshape(-1)
+        check_label_shapes(label, pred, shape=1)
+        return (pred == label).sum(), int(pred.size)
 
 
 class TopKAccuracy(EvalMetric):
@@ -147,6 +278,18 @@ class TopKAccuracy(EvalMetric):
                         pred_np[:, num_classes - 1 - j].flat == label_np.flat
                     ).sum()
             self.num_inst += num_samples
+
+    def _device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        if pred.ndim != 2:
+            return None  # mirror the numpy path's 2-D argsort contract
+        order = jnp.argsort(pred.astype(jnp.float32), axis=1)
+        label = label.astype(jnp.int32).reshape(-1)
+        num_classes = pred.shape[1]
+        top_k = min(num_classes, self.top_k)
+        hits = (order[:, num_classes - top_k:] == label[:, None]).sum()
+        return hits, int(pred.shape[0])
 
 
 class F1(EvalMetric):
@@ -229,6 +372,13 @@ class MAE(EvalMetric):
             self.sum_metric += _np.abs(label - pred).mean()
             self.num_inst += 1
 
+    def _device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        if label.ndim == 1:
+            label = label.reshape(label.shape[0], 1)
+        return jnp.abs(label - pred).mean(), 1
+
 
 class MSE(EvalMetric):
     def __init__(self, name="mse"):
@@ -244,6 +394,11 @@ class MSE(EvalMetric):
             self.sum_metric += ((label - pred) ** 2.0).mean()
             self.num_inst += 1
 
+    def _device_batch(self, label, pred):
+        if label.ndim == 1:
+            label = label.reshape(label.shape[0], 1)
+        return ((label - pred) ** 2.0).mean(), 1
+
 
 class RMSE(EvalMetric):
     def __init__(self, name="rmse"):
@@ -258,6 +413,13 @@ class RMSE(EvalMetric):
                 label = label.reshape(label.shape[0], 1)
             self.sum_metric += _np.sqrt(((label - pred) ** 2.0).mean())
             self.num_inst += 1
+
+    def _device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        if label.ndim == 1:
+            label = label.reshape(label.shape[0], 1)
+        return jnp.sqrt(((label - pred) ** 2.0).mean()), 1
 
 
 class CrossEntropy(EvalMetric):
@@ -276,6 +438,16 @@ class CrossEntropy(EvalMetric):
             self.sum_metric += (-_np.log(prob + self.eps)).sum()
             self.num_inst += label.shape[0]
 
+    def _device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        label = label.reshape(-1)
+        if label.shape[0] != pred.shape[0]:
+            return None  # numpy path asserts; let it raise there
+        n = label.shape[0]
+        prob = pred[jnp.arange(n), label.astype(jnp.int32)]
+        return (-jnp.log(prob + self.eps)).sum(), int(n)
+
 
 class Loss(EvalMetric):
     """Mean of the raw outputs (for MakeLoss heads, reference Loss)."""
@@ -287,6 +459,10 @@ class Loss(EvalMetric):
         for pred in preds:
             self.sum_metric += pred.asnumpy().sum()
             self.num_inst += pred.size
+
+    def _device_batches(self, labels, preds):
+        # labels are unused (and may be absent) for Loss heads
+        return [(_dev_val(p).sum(), int(p.size)) for p in preds]
 
 
 class Torch(Loss):

@@ -299,7 +299,13 @@ class DataParallelExecutorGroup:
         return [[self._exec.aux_dict[n]] for n in self.aux_names]
 
     def update_metric(self, eval_metric, labels):
-        eval_metric.update(labels, self.get_outputs())
+        # prefer on-device accumulation (no per-batch asnumpy sync); metrics
+        # without a device formula fall back to numpy inside device_update
+        dev = getattr(eval_metric, "device_update", None)
+        if dev is not None:
+            dev(labels, self.get_outputs())
+        else:
+            eval_metric.update(labels, self.get_outputs())
 
     def install_monitor(self, mon):
         mon.install(self._exec)

@@ -38,8 +38,6 @@ struct Handle {
   std::string json;
   // simple-bind scratch: the returned in_args/arg_grads/aux handle arrays
   std::vector<void*> hvec[3];
-  // infer-type scratch: dtype codes for (args, outputs, aux)
-  std::vector<int> types[3];
   Handle() { handle_reg(this); }
   ~Handle() {
     handle_unreg(this);
@@ -761,6 +759,9 @@ int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
   MXTPU_GUARD_PTR(outputs);
   MXTPU_GUARD_PTR(num_outputs);
   MXTPU_GUARD_HANDLE_ARRAY(inputs, num_inputs > 0 ? num_inputs : 0);
+  if (*outputs != NULL) {  // caller-provided out= arrays must be live too
+    MXTPU_GUARD_HANDLE_ARRAY(*outputs, *num_outputs > 0 ? *num_outputs : 0);
+  }
   MXTPU_API_BEGIN();
   if (!mxtpu::ensure_op_table()) break;
   size_t idx = (size_t)(uintptr_t)creator;
@@ -1677,6 +1678,11 @@ int MXSymbolInferType(SymbolHandle symbol, uint32_t num_args,
   MXTPU_GUARD_PTR(out_type_size);
   MXTPU_GUARD_PTR(complete);
   MXTPU_GUARD_PTR(out_type_data);
+  if (num_args > 0 && (keys == NULL || arg_type_data == NULL)) {
+    mxtpu::g_last_error =
+        "NULL keys/arg_type_data with num_args > 0 in MXSymbolInferType";
+    return -1;
+  }
   MXTPU_API_BEGIN();
   PyObject* klist = PyList_New(num_args);
   PyObject* tlist = PyList_New(num_args);
@@ -1688,7 +1694,11 @@ int MXSymbolInferType(SymbolHandle symbol, uint32_t num_args,
       "sym_infer_type",
       Py_BuildValue("(ONN)", H(symbol)->obj, klist, tlist));
   if (!r) break;
-  Handle* h = H(symbol);
+  // thread-local scratch (valid until this thread's next call, like the
+  // reference's per-thread MXAPIThreadLocalEntry) — parking the vectors on
+  // the symbol Handle instead would race concurrent inference on the same
+  // symbol from two threads
+  static thread_local std::vector<int> tl_types[3];
   bool ok = true;
   for (int g = 0; g < 3; ++g) {
     PyObject* lst = PyTuple_GET_ITEM(r, g);
@@ -1697,22 +1707,22 @@ int MXSymbolInferType(SymbolHandle symbol, uint32_t num_args,
       ok = false;
       break;
     }
-    h->types[g].clear();
+    tl_types[g].clear();
     for (Py_ssize_t i = 0; i < n; ++i) {
       PyObject* it = PySequence_GetItem(lst, i);
-      h->types[g].push_back(it ? (int)PyLong_AsLong(it) : -1);
+      tl_types[g].push_back(it ? (int)PyLong_AsLong(it) : -1);
       Py_XDECREF(it);
     }
   }
   if (ok) *complete = (int)PyLong_AsLong(PyTuple_GET_ITEM(r, 3));
   Py_DECREF(r);
   if (!ok) break;
-  *in_type_size = (uint32_t)h->types[0].size();
-  *in_type_data = h->types[0].data();
-  *out_type_size = (uint32_t)h->types[1].size();
-  *out_type_data = h->types[1].data();
-  *aux_type_size = (uint32_t)h->types[2].size();
-  *aux_type_data = h->types[2].data();
+  *in_type_size = (uint32_t)tl_types[0].size();
+  *in_type_data = tl_types[0].data();
+  *out_type_size = (uint32_t)tl_types[1].size();
+  *out_type_data = tl_types[1].data();
+  *aux_type_size = (uint32_t)tl_types[2].size();
+  *aux_type_data = tl_types[2].data();
   MXTPU_API_END();
 }
 
@@ -1858,6 +1868,9 @@ int MXCachedInvoke(CachedOpHandle handle, int num_inputs,
   MXTPU_GUARD_PTR(num_outputs);
   MXTPU_GUARD_PTR(outputs);
   MXTPU_GUARD_HANDLE_ARRAY(inputs, num_inputs > 0 ? num_inputs : 0);
+  if (*outputs != NULL) {  // caller-provided out= arrays must be live too
+    MXTPU_GUARD_HANDLE_ARRAY(*outputs, *num_outputs > 0 ? *num_outputs : 0);
+  }
   MXTPU_API_BEGIN();
   PyObject* in_l = PyList_New(num_inputs);
   for (int i = 0; i < num_inputs; ++i) {

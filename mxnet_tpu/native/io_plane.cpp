@@ -768,8 +768,16 @@ int64_t mxio_pack_list(const char* list_path, const char* root,
       ++packed;
     }
   }
-  int xerr = xf ? (ferror(xf) | fclose(xf)) : 0;
-  if (ferror(rf) | fclose(rf) | xerr) return -1;
+  // sequence ferror before fclose explicitly: ferror(f) | fclose(f) is an
+  // unsequenced read/invalidate of the same FILE* (UB)
+  int xerr = 0;
+  if (xf) {
+    xerr = ferror(xf);
+    xerr |= fclose(xf);
+  }
+  int rerr = ferror(rf);
+  rerr |= fclose(rf);
+  if (rerr | xerr) return -1;
   return packed;
 }
 

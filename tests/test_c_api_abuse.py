@@ -25,10 +25,14 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.fixture(scope="module")
 def lib(tmp_path_factory):
     out_dir = str(tmp_path_factory.mktemp("amal_abuse"))
+    env = dict(os.environ)
+    # a leaked axon pool address makes any spawned jax-initialising child
+    # dial the pool and hang for the full timeout; always scrub it
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     r = subprocess.run(
         ["python", os.path.join(_ROOT, "tools", "amalgamation.py"),
          "--out-dir", out_dir],
-        capture_output=True, text=True, cwd=_ROOT,
+        capture_output=True, text=True, cwd=_ROOT, env=env,
     )
     assert r.returncode == 0, r.stderr
     L = ctypes.CDLL(os.path.join(out_dir, "libmxtpu.so"))

@@ -195,10 +195,14 @@ def _mlp():
 @pytest.fixture(scope="module")
 def amalgamated(tmp_path_factory):
     out_dir = str(tmp_path_factory.mktemp("amal"))
+    env = dict(os.environ)
+    # a leaked axon pool address makes any spawned jax-initialising child
+    # dial the pool and hang for the full timeout; always scrub it
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     r = subprocess.run(
         ["python", os.path.join(_ROOT, "tools", "amalgamation.py"),
          "--out-dir", out_dir],
-        capture_output=True, text=True, cwd=_ROOT,
+        capture_output=True, text=True, cwd=_ROOT, env=env,
     )
     assert r.returncode == 0, r.stderr
     return out_dir
@@ -231,6 +235,8 @@ def test_c_introspection_tier(amalgamated, tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
+    # known 300s hang mode: the embedded interpreter dials the axon pool
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     r = subprocess.run(
         [client, prefix + "-symbol.json", prefix + "-0000.params", resave],
         capture_output=True, text=True, env=env, timeout=300,
@@ -287,10 +293,12 @@ def test_cached_op_tier(tmp_path):
     import subprocess
 
     out_dir = str(tmp_path / "amal")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     r = subprocess.run(
         ["python", os.path.join(_ROOT, "tools", "amalgamation.py"),
          "--out-dir", out_dir],
-        capture_output=True, text=True, cwd=_ROOT,
+        capture_output=True, text=True, cwd=_ROOT, env=env,
     )
     assert r.returncode == 0, r.stderr
     L = ctypes.CDLL(os.path.join(out_dir, "libmxtpu.so"))
