@@ -21,6 +21,14 @@ epoch free of per-batch host syncs, so the fit loop must reach the
 ``train_window`` steady-state rate (the async-pipeline acceptance bar).
 Epochs are timed at their epoch_end_callback boundaries; the first epoch
 (compile) is discarded and the median of the rest is reported.
+
+The result JSON always embeds a telemetry snapshot (``"telemetry"`` key)
+so BENCH_* files carry the bound — data- vs dispatch- vs sync-bound — of
+the measured run. With ``MXNET_TELEMETRY=1`` in fit mode, the run
+additionally captures host spans + the jax device trace and writes one
+merged Perfetto-loadable timeline (``BENCH_TRACE_OUT``, default
+bench_trace.json) plus the snapshot JSON/Prometheus pair
+(``BENCH_TELEMETRY_OUT``, default bench_telemetry.json).
 """
 
 import json
@@ -66,6 +74,12 @@ def _run_fit_mode(mx, mod, batch_size, image, dtype, iters, windows):
 
     def epoch_mark(epoch, sym=None, arg=None, aux=None):
         marks.append(time.time())
+        if epoch == 0:
+            # the first (compile) epoch is discarded from the timing; drop
+            # its telemetry too so the embedded snapshot reflects the
+            # steady state (compile-epoch dispatch times would dwarf the
+            # per-batch phase numbers the bound verdict reads)
+            mx.telemetry.reset()
 
     metric = mx.metric.Accuracy()
     t0 = time.time()
@@ -102,6 +116,17 @@ def main():
                         on_tpu)
 
     if mode == "fit":
+        # MXNET_TELEMETRY=1: record host spans + the jax device trace over
+        # the fit epochs and write one merged Chrome/Perfetto timeline
+        tracing = mx.telemetry.spans_enabled()
+        if tracing:
+            trace_out = os.environ.get("BENCH_TRACE_OUT", "bench_trace.json")
+            mx.profiler.profiler_set_config(
+                filename=os.path.splitext(trace_out)[0] + "_device.json")
+            mx.profiler.profiler_set_state("run")
+        # _run_fit_mode resets telemetry again at the first epoch boundary
+        # so the snapshot covers the steady-state epochs only
+        mx.telemetry.reset()
         img_per_sec, spread = _run_fit_mode(
             mx, mod, batch_size, image, dtype, max(iters, 2), max(windows, 2))
         record = {
@@ -111,7 +136,18 @@ def main():
             "unit": "images/sec",
             "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
             "spread": round(spread, 4),
+            "telemetry": mx.telemetry.snapshot(),
         }
+        if tracing:
+            device_trace = mx.profiler.dump_profile()  # stops the trace
+            merged = mx.telemetry.merge_chrome_trace(
+                mx.telemetry.events(), device_trace, trace_out)
+            snap_path, prom_path = mx.telemetry.dump(
+                os.environ.get("BENCH_TELEMETRY_OUT", "bench_telemetry.json"))
+            record["trace"] = merged
+            record["telemetry_snapshot"] = snap_path
+            print(f"merged trace: {merged}  snapshot: {snap_path} "
+                  f"{prom_path}", file=sys.stderr)
         print(json.dumps(record))
         return
 
@@ -145,6 +181,7 @@ def main():
     # an extra program shape the timed region never uses
     run_steps(((max(warmup, 2 * fused) + fused - 1) // fused) * fused)
     fence()
+    mx.telemetry.reset()  # snapshot covers the timed steady state only
 
     # several independently-timed windows: the reported value is the
     # median window, and the spread (max-min)/median is emitted so a
@@ -170,6 +207,7 @@ def main():
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
         "spread": round(spread, 4),
+        "telemetry": mx.telemetry.snapshot(),
     }
     if on_tpu and num_layers == 50 and dtype == "bfloat16":
         # MFU note: ResNet-50@224 train ≈ 3x fwd FLOPs ≈ 12.3 GFLOP/img.

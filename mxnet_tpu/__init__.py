@@ -86,6 +86,7 @@ from . import module as mod
 from . import rnn
 from . import image
 from . import profiler
+from . import telemetry
 from . import visualization
 from . import visualization as viz
 from . import test_utils

@@ -19,6 +19,7 @@ from __future__ import annotations
 import threading
 
 from . import env as _env
+from . import telemetry as _telemetry
 
 
 class _Var:
@@ -68,6 +69,7 @@ class Engine:
 
         for var in tuple(read_vars) + tuple(write_vars):
             for nd in getattr(var, "_arrays", ()):
+                _telemetry.counter("ndarray.wait_to_read").inc()
                 jax.block_until_ready(nd._data)
         fn()
 
@@ -77,6 +79,7 @@ class Engine:
         import jax
 
         for nd in getattr(var, "_arrays", ()):
+            _telemetry.counter("ndarray.wait_to_read").inc()
             jax.block_until_ready(nd._data)
 
     def wait_for_all(self):

@@ -35,6 +35,7 @@ from .base import MXNetError, np_dtype
 from .context import Context, current_context
 from .ndarray import NDArray, ones as nd_ones, zeros as nd_zeros
 from .ops.registry import OpMode
+from . import telemetry as _tm
 
 _GRAD_REQ = ("write", "add", "null")
 
@@ -628,56 +629,63 @@ class Executor:
         )
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
+            _tm.counter("executor.jit_cache_hit").inc()
             return fn
-        graph = self.graph
+        # a miss here means a new XLA program for this graph/shape/mesh
+        # signature — recompiles in steady state are a perf bug worth
+        # surfacing (the reference's cached-op cache-miss analogue)
+        _tm.counter("executor.jit_compile").inc()
+        with _tm.span("executor.jit_build", kind=kind):
+            graph = self.graph
 
-        if kind == "forward":
+            if kind == "forward":
 
-            def _fwd(arg_vals, arg_flat, aux_vals, aux_flat, rng):
-                full_args = _fill_packed(arg_vals, arg_flat, arg_fill)
-                full_aux = _fill_packed(aux_vals, aux_flat, aux_fill)
-                outs, aux_upd = graph.evaluate(
-                    full_args, full_aux, _fold_rng(rng), is_train
+                def _fwd(arg_vals, arg_flat, aux_vals, aux_flat, rng):
+                    full_args = _fill_packed(arg_vals, arg_flat, arg_fill)
+                    full_aux = _fill_packed(aux_vals, aux_flat, aux_fill)
+                    outs, aux_upd = graph.evaluate(
+                        full_args, full_aux, _fold_rng(rng), is_train
+                    )
+                    aux_big, aux_flat_out = _split_out(aux_upd, aux_fill)
+                    return outs, aux_big, aux_flat_out, _next_step(rng)
+
+                fn = _fwd if (self._node2dev or self._naive) else jax.jit(
+                    _fwd, compiler_options=_tpu_compiler_options(self._ctx)
                 )
-                aux_big, aux_flat_out = _split_out(aux_upd, aux_fill)
-                return outs, aux_big, aux_flat_out, _next_step(rng)
+            elif kind == "train_step":
+                core = self._make_grad_core()
+                grad_names = tuple(arg_pack["names"]) if arg_pack else ()
 
-            fn = _fwd if (self._node2dev or self._naive) else jax.jit(
-                _fwd, compiler_options=_tpu_compiler_options(self._ctx)
-            )
-        elif kind == "train_step":
-            core = self._make_grad_core()
-            grad_names = tuple(arg_pack["names"]) if arg_pack else ()
+                def _tstep(arg_vals, arg_flat, aux_vals, aux_flat, rng, heads,
+                           prev):
+                    import jax.numpy as jnp
 
-            def _tstep(arg_vals, arg_flat, aux_vals, aux_flat, rng, heads,
-                       prev):
-                import jax.numpy as jnp
+                    full_args = _fill_packed(arg_vals, arg_flat, arg_fill)
+                    full_aux = _fill_packed(aux_vals, aux_flat, aux_fill)
+                    outs, aux_upd, grad_map = core(
+                        full_args, full_aux, rng, heads, prev
+                    )
+                    aux_big, aux_flat_out = _split_out(aux_upd, aux_fill)
+                    grad_flat = None
+                    if grad_names:
+                        grad_map = dict(grad_map)
+                        grad_flat = jnp.concatenate([
+                            grad_map.pop(n).astype(jnp.float32).ravel()
+                            for n in grad_names
+                        ])
+                    return (outs, aux_big, aux_flat_out, grad_map, grad_flat,
+                            _next_step(rng))
 
-                full_args = _fill_packed(arg_vals, arg_flat, arg_fill)
-                full_aux = _fill_packed(aux_vals, aux_flat, aux_fill)
-                outs, aux_upd, grad_map = core(
-                    full_args, full_aux, rng, heads, prev
+                # ctx-group placement spans devices: XLA compiles
+                # single-device (or SPMD-sharded) programs only, so a
+                # placed graph executes eagerly — per-op dispatch on the
+                # op's device, like the reference engine's per-device
+                # worker queues
+                fn = _tstep if (self._node2dev or self._naive) else jax.jit(
+                    _tstep, compiler_options=_tpu_compiler_options(self._ctx)
                 )
-                aux_big, aux_flat_out = _split_out(aux_upd, aux_fill)
-                grad_flat = None
-                if grad_names:
-                    grad_map = dict(grad_map)
-                    grad_flat = jnp.concatenate([
-                        grad_map.pop(n).astype(jnp.float32).ravel()
-                        for n in grad_names
-                    ])
-                return (outs, aux_big, aux_flat_out, grad_map, grad_flat,
-                        _next_step(rng))
-
-            # ctx-group placement spans devices: XLA compiles single-device
-            # (or SPMD-sharded) programs only, so a placed graph executes
-            # eagerly — per-op dispatch on the op's device, like the
-            # reference engine's per-device worker queues
-            fn = _tstep if (self._node2dev or self._naive) else jax.jit(
-                _tstep, compiler_options=_tpu_compiler_options(self._ctx)
-            )
-        else:
-            raise MXNetError(f"unknown jit kind {kind}")
+            else:
+                raise MXNetError(f"unknown jit kind {kind}")
         self._jit_cache[cache_key] = fn
         return fn
 
@@ -1121,6 +1129,10 @@ class Executor:
                     state_handles is not None, sched_mesh, n_steps,
                     stack_names)
         plan = self._fused_plan.get(plan_key)
+        if plan is not None:
+            _tm.counter("executor.fused_plan_hit").inc()
+        else:
+            _tm.counter("executor.fused_plan_compile").inc()
         if plan is None:
             if state_handles is not None and state_leaves is None:
                 state_leaves = [h._data for h in state_handles]

@@ -19,12 +19,22 @@ import os
 import queue as _queue
 import struct
 import threading
+import time as _time
 from collections import namedtuple
 
 import numpy as np
 
 from .base import MXNetError, np_dtype
 from .ndarray import NDArray, array
+from . import telemetry as _telemetry
+
+# DevicePrefetchIter health: batch count, staging-queue depth seen by the
+# consumer, time the producer sat on a full queue (consumer is the
+# bottleneck) and time the consumer waited on an empty one (data-bound)
+_PF_BATCHES = _telemetry.counter("io.prefetch.batches")
+_PF_DEPTH = _telemetry.gauge("io.prefetch.queue_depth")
+_PF_STALL = _telemetry.histogram("io.prefetch.producer_stall_us")
+_PF_WAIT = _telemetry.histogram("io.prefetch.consumer_wait_us")
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -526,14 +536,21 @@ class DevicePrefetchIter(DataIter):
                 return
             if not self._put(q, abort, batch):
                 return
+            _PF_BATCHES.inc()
 
     @staticmethod
     def _put(q, abort, item):
+        t0 = None
         while not abort.is_set():
             try:
                 q.put(item, timeout=0.05)
+                if t0 is not None:
+                    _PF_STALL.observe(
+                        (_time.perf_counter_ns() - t0) // 1000)
                 return True
             except _queue.Full:
+                if t0 is None:
+                    t0 = _time.perf_counter_ns()
                 continue
         return False
 
@@ -579,7 +596,10 @@ class DevicePrefetchIter(DataIter):
             raise MXNetError("DevicePrefetchIter used after close()")
         if self._exhausted:
             return False
+        _PF_DEPTH.set(self._queue.qsize())
+        t0 = _time.perf_counter_ns()
         item = self._queue.get()
+        _PF_WAIT.observe((_time.perf_counter_ns() - t0) // 1000)
         if item is _EPOCH_END:
             self.current_batch = None
             self._exhausted = True

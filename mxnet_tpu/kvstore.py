@@ -31,10 +31,29 @@ import pickle
 
 from .base import MXNetError
 from .ndarray import NDArray, zeros
+from . import telemetry as _tm
 
 
 def _key_str(key):
     return str(key)
+
+
+def _nbytes(v):
+    """Payload size of one pushed/pulled value (0 when unknowable)."""
+    import numpy as np
+
+    if isinstance(v, (list, tuple)):
+        return sum(_nbytes(x) for x in v)
+    try:
+        return int(v.size) * np.dtype(v.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def _count_io(op, keys, values):
+    """Count a push/pull against the kvstore telemetry counters."""
+    _tm.counter(f"kvstore.{op}").inc(len(keys))
+    _tm.counter(f"kvstore.{op}_bytes").inc(sum(_nbytes(v) for v in values))
 
 
 def _merge_pushed(v):
@@ -93,6 +112,7 @@ class KVStore:
         from .sparse_ndarray import BaseSparseNDArray
 
         keys, values = _key_value(key, value)
+        _count_io("push", keys, values)
         for k, v in zip(keys, values):
             merged = _merge_pushed(v)
             if k not in self._store:
@@ -107,6 +127,7 @@ class KVStore:
     def pull(self, key, out=None, priority=0):
         assert out is not None
         keys, outs = _key_value(key, out)
+        _count_io("pull", keys, outs)
         for k, o in zip(keys, outs):
             src = self._store[k]
             if isinstance(o, (list, tuple)):
@@ -322,6 +343,7 @@ class DistKVStore(KVStore):
         from .sparse_ndarray import BaseSparseNDArray
 
         keys, values = _key_value(key, value)
+        _count_io("push", keys, values)
         for k, v in zip(keys, values):
             merged = _merge_pushed(v)
             if isinstance(merged, BaseSparseNDArray):
@@ -341,10 +363,12 @@ class DistKVStore(KVStore):
         import jax
         import jax.numpy as jnp
 
+        _tm.counter("kvstore.barrier").inc()
         if self.num_workers > 1:
             from .ndarray import NDArray as _ND
 
-            jax.block_until_ready(self._allreduce(_ND(jnp.ones((1,)))))
+            with _tm.span("kvstore.barrier_wait"):
+                jax.block_until_ready(self._allreduce(_ND(jnp.ones((1,)))))
 
 
 def create(name="local"):

@@ -48,6 +48,7 @@ import numpy as np
 
 from .base import MXNetError
 from .kvstore import KVStore, _updater_key
+from . import telemetry as _tm
 
 # --- wire protocol ---------------------------------------------------------
 # frame: header | dims | key-utf8 | payload | [mac]
@@ -477,10 +478,12 @@ class AsyncDistKVStore(KVStore):
         from .kvstore import _key_value, _merge_pushed
 
         keys, vals = _key_value(key, value)
+        _tm.counter("kvstore_async.push").inc(len(keys))
         for k, v in zip(keys, vals):
             merged = _merge_pushed(v)
-            self._rpc(_OP_PUSH, k, np.asarray(merged.asnumpy()),
-                      flags=int(self._has_optimizer))
+            wire = np.asarray(merged.asnumpy())
+            _tm.counter("kvstore_async.push_bytes").inc(wire.nbytes)
+            self._rpc(_OP_PUSH, k, wire, flags=int(self._has_optimizer))
 
     def pull(self, key, out=None, priority=0):
         from .kvstore import _key_value
@@ -488,8 +491,11 @@ class AsyncDistKVStore(KVStore):
 
         assert out is not None
         keys, outs = _key_value(key, out)
+        _tm.counter("kvstore_async.pull").inc(len(keys))
         for k, o in zip(keys, outs):
             arr = self._rpc(_OP_PULL, k)
+            _tm.counter("kvstore_async.pull_bytes").inc(
+                getattr(arr, "nbytes", 0))
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 if isinstance(t, NDArray):
@@ -529,7 +535,9 @@ class AsyncDistKVStore(KVStore):
         )
 
     def barrier(self):
-        self._rpc(_OP_BARRIER)
+        _tm.counter("kvstore.barrier").inc()
+        with _tm.span("kvstore_async.barrier_wait"):
+            self._rpc(_OP_BARRIER)
 
     @property
     def type(self):

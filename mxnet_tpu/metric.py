@@ -22,6 +22,13 @@ import numpy as _np
 
 from .base import MXNetError
 from .ndarray import NDArray
+from . import telemetry as _telemetry
+
+# per-batch accumulation path taken (device formula vs synchronous numpy
+# fallback) and epoch-granularity drains — the pipeline's sync budget
+_CNT_DEVICE = _telemetry.counter("metric.device_update")
+_CNT_FALLBACK = _telemetry.counter("metric.numpy_fallback")
+_CNT_DRAIN = _telemetry.counter("metric.drain_sync")
 
 
 def _dev_val(x):
@@ -92,21 +99,25 @@ class EvalMetric:
         fell back to the (synchronous) numpy ``update``.
         """
         if self.num is not None:
+            _CNT_FALLBACK.inc()
             self.update(labels, preds)
             return False
         contribs = self._device_batches(labels, preds)
         if contribs is None:
+            _CNT_FALLBACK.inc()
             self._drain_device()  # keep ordering if paths interleave
             self.update(labels, preds)
             return False
         for s, n in contribs:
             self._dev_sum = s if self._dev_sum is None else self._dev_sum + s
             self._dev_inst += n
+        _CNT_DEVICE.inc()
         return True
 
     def _drain_device(self):
         """Fold the device accumulator into the host sums (syncs)."""
         if self._dev_sum is not None:
+            _CNT_DRAIN.inc()
             self.sum_metric += float(self._dev_sum)
             self.num_inst += self._dev_inst
             self._dev_sum = None

@@ -27,6 +27,13 @@ from .context import Context, cpu, current_context
 from .ops import registry as _reg
 from .ops.registry import OpMode
 from . import random as _random
+from . import telemetry as _telemetry
+
+# every host-blocking device sync in the framework flows through one of
+# these two calls; counting them is the observable "no per-batch sync"
+# invariant the async pipeline is built on (tests/test_async_pipeline.py)
+_SYNC_ASNUMPY = _telemetry.counter("ndarray.asnumpy")
+_SYNC_WAIT = _telemetry.counter("ndarray.wait_to_read")
 
 
 def _is_np_shape_scalar(x):
@@ -144,6 +151,7 @@ class NDArray:
 
     # --- conversion -------------------------------------------------------
     def asnumpy(self):
+        _SYNC_ASNUMPY.inc()
         return np.asarray(self._data)
 
     def asscalar(self):
@@ -190,6 +198,7 @@ class NDArray:
     def wait_to_read(self):
         import jax
 
+        _SYNC_WAIT.inc()
         jax.block_until_ready(self._data)
 
     def wait_to_write(self):

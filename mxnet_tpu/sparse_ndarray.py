@@ -127,6 +127,7 @@ class BaseSparseNDArray(NDArray):
     to_dense = todense
 
     def asnumpy(self):
+        _nd._SYNC_ASNUMPY.inc()
         return np.asarray(self._data)
 
     def astype(self, dtype):
@@ -162,6 +163,7 @@ class BaseSparseNDArray(NDArray):
     def wait_to_read(self):
         import jax
 
+        _nd._SYNC_WAIT.inc()
         jax.block_until_ready(self._values)
 
     # --- unsupported dense conveniences (reference parity) ----------------

@@ -1,0 +1,282 @@
+"""Telemetry subsystem (ISSUE 2): registry semantics, span recording,
+hot-path instrumentation wiring, and the host+device trace merge."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import telemetry as tm  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    tm.reset()
+    spans = tm.spans_enabled()
+    yield
+    tm.enable_spans(spans)
+    tm.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+def test_counter_gauge_histogram_basics():
+    c = tm.counter("t.c")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert tm.counter("t.c") is c  # same handle on re-lookup
+
+    g = tm.gauge("t.g")
+    g.set(3)
+    g.set(1)
+    assert g.value == 1 and g.max == 3
+
+    h = tm.histogram("t.h")
+    for v in (10, 2, 8):
+        h.observe(v)
+    assert h.count == 3 and h.sum == 20 and h.min == 2 and h.max == 10
+
+
+def test_kind_collision_raises():
+    tm.counter("t.kind")
+    with pytest.raises(TypeError):
+        tm.gauge("t.kind")
+
+
+def test_reset_keeps_handles_valid():
+    c = tm.counter("t.reset")
+    c.inc(7)
+    tm.reset()
+    assert c.value == 0
+    c.inc()
+    assert tm.counter("t.reset").value == 1
+
+
+def test_snapshot_nests_on_dots():
+    tm.counter("a.b.c").inc(2)
+    tm.gauge("a.b.g").set(9)
+    snap = tm.snapshot()
+    assert snap["a"]["b"]["c"] == 2
+    assert snap["a"]["b"]["g"]["value"] == 9
+
+
+def test_snapshot_instrument_nested_under_instrument():
+    # "n.h" (a histogram whose rendering is itself a dict) and "n.h.retries"
+    # must come out as two distinct metrics, not merge into one dict
+    tm.histogram("n.h").observe(3)
+    tm.counter("n.h.retries").inc(2)
+    snap = tm.snapshot()
+    assert snap["n"]["h"][""]["count"] == 1
+    assert snap["n"]["h"]["retries"] == 2
+
+
+def test_enable_spans_mid_span_records_cleanly():
+    tm.enable_spans(False)
+    s = tm.span("mid.span")
+    s.__enter__()
+    tm.enable_spans(True)  # e.g. from a callback while fit spans are open
+    s.__exit__(None, None, None)
+    assert [e["name"] for e in tm.events()] == ["mid.span"]
+
+
+def test_dump_writes_json_and_prometheus(tmp_path):
+    tm.counter("d.count").inc(3)
+    tm.histogram("d.hist").observe(5)
+    json_path, prom_path = tm.dump(str(tmp_path / "snap.json"))
+    with open(json_path) as f:
+        snap = json.load(f)
+    assert snap["d"]["count"] == 3
+    prom = open(prom_path).read()
+    assert "# TYPE mxnet_d_count counter" in prom
+    assert "mxnet_d_count 3" in prom
+    assert "mxnet_d_hist_count 1" in prom
+    assert "mxnet_d_hist_sum 5" in prom
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+def test_span_histogram_always_on_events_gated():
+    tm.enable_spans(False)
+    with tm.span("t.phase"):
+        pass
+    assert tm.histogram("t.phase").count == 1
+    assert tm.events() == []
+
+    tm.enable_spans(True)
+    with tm.span("t.phase", detail="x"):
+        pass
+    evts = tm.events()
+    assert len(evts) == 1
+    ev = evts[0]
+    assert ev["name"] == "t.phase" and ev["ph"] == "X"
+    assert ev["dur"] >= 1 and "ts" in ev and "pid" in ev and "tid" in ev
+    assert ev["args"] == {"detail": "x"}
+    assert tm.histogram("t.phase").count == 2
+
+
+def test_dump_trace_and_merge(tmp_path):
+    tm.enable_spans(True)
+    with tm.span("fit.data_wait"):
+        pass
+    host_path = tm.dump_trace(str(tmp_path / "host.json"))
+    device_path = str(tmp_path / "device.json")
+    with open(device_path, "w") as f:
+        json.dump({"traceEvents": [
+            {"name": "fusion", "ph": "X", "ts": 1, "dur": 2,
+             "pid": 99, "tid": 1}],
+            "metadata": {"clock": "tsc"}}, f)
+    out = tm.merge_chrome_trace(host_path, device_path,
+                                str(tmp_path / "merged.json"))
+    with open(out) as f:
+        merged = json.load(f)
+    names = {e["name"] for e in merged["traceEvents"]}
+    assert {"fit.data_wait", "fusion"} <= names
+    assert merged["metadata"] == {"clock": "tsc"}  # device metadata kept
+
+
+def test_merge_accepts_event_list_and_missing_device(tmp_path):
+    tm.enable_spans(True)
+    with tm.span("host.only"):
+        pass
+    out = tm.merge_chrome_trace(tm.events(), None,
+                                str(tmp_path / "host_only.json"))
+    with open(out) as f:
+        merged = json.load(f)
+    assert [e["name"] for e in merged["traceEvents"]] == ["host.only"]
+
+
+def test_trace_merge_cli_smoke(tmp_path):
+    """tools/trace_merge.py merges a host span file + gzipped device trace."""
+    import gzip
+
+    host = tmp_path / "host.json"
+    with open(host, "w") as f:
+        json.dump({"traceEvents": [
+            {"name": "fit.dispatch", "ph": "X", "ts": 5, "dur": 3,
+             "pid": 1, "tid": 1}]}, f)
+    device = tmp_path / "device.trace.json.gz"
+    with gzip.open(device, "wt") as f:
+        json.dump({"traceEvents": [
+            {"name": "xla_op", "ph": "X", "ts": 6, "dur": 1,
+             "pid": 2, "tid": 2}]}, f)
+    out = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "trace_merge.py"),
+         str(host), str(device), "-o", str(out)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    with open(out) as f:
+        merged = json.load(f)
+    assert {e["name"] for e in merged["traceEvents"]} == {
+        "fit.dispatch", "xla_op"}
+
+
+# ---------------------------------------------------------------------------
+# hot-path wiring
+# ---------------------------------------------------------------------------
+def test_prefetch_iter_counters():
+    rng = np.random.RandomState(0)
+    it = mx.io.NDArrayIter(
+        rng.uniform(size=(32, 4)).astype(np.float32),
+        rng.randint(0, 3, (32,)).astype(np.float32),
+        batch_size=8, last_batch_handle="discard")
+    pf = mx.io.DevicePrefetchIter(it)
+    n = sum(1 for _ in pf)
+    pf.close()
+    assert n == 4
+    assert tm.counter("io.prefetch.batches").value == 4
+    assert tm.histogram("io.prefetch.consumer_wait_us").count == 5  # +EOF
+
+
+def test_metric_counters_device_vs_fallback():
+    rng = np.random.RandomState(1)
+    p = rng.uniform(0.05, 1.0, (16, 4)).astype(np.float32)
+    labels = [mx.nd.array(rng.randint(0, 4, (16,)).astype(np.float32))]
+    preds = [mx.nd.array(p / p.sum(axis=1, keepdims=True))]
+
+    m = mx.metric.Accuracy()
+    m.device_update(labels, preds)
+    assert tm.counter("metric.device_update").value == 1
+    assert tm.counter("metric.numpy_fallback").value == 0
+    m.get()
+    assert tm.counter("metric.drain_sync").value == 1
+
+    class NoDevice(mx.metric.Accuracy):
+        def _device_batch(self, label, pred):
+            return None
+
+    NoDevice().device_update(labels, preds)
+    assert tm.counter("metric.numpy_fallback").value == 1
+
+
+def test_kvstore_counters():
+    kv = mx.kv.create("local")
+    a = mx.nd.array(np.ones((4, 4), np.float32))
+    kv.init("w", a)
+    kv.push("w", mx.nd.array(np.full((4, 4), 2.0, np.float32)))
+    out = mx.nd.array(np.zeros((4, 4), np.float32))
+    kv.pull("w", out=out)
+    assert tm.counter("kvstore.push").value == 1
+    assert tm.counter("kvstore.push_bytes").value == 64
+    assert tm.counter("kvstore.pull").value == 1
+    assert tm.counter("kvstore.pull_bytes").value == 64
+
+
+def test_executor_jit_cache_counters():
+    d = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(d, num_hidden=4, name="fc")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 3), grad_req="null")
+    tm.reset()
+    # forward is lazy: reading an output materializes (and jit-builds) it
+    exe.forward(is_train=False, data=mx.nd.array(np.ones((2, 3), np.float32)))
+    _ = exe.outputs[0].shape
+    compiles = tm.counter("executor.jit_compile").value
+    assert compiles >= 1
+    exe.forward(is_train=False, data=mx.nd.array(np.ones((2, 3), np.float32)))
+    _ = exe.outputs[0].shape
+    assert tm.counter("executor.jit_compile").value == compiles  # no recompile
+    assert tm.counter("executor.jit_cache_hit").value >= 1
+
+
+def test_sync_counters_count_blocking_reads():
+    a = mx.nd.array(np.ones((2, 2), np.float32))
+    base = tm.counter("ndarray.asnumpy").value
+    a.asnumpy()
+    assert tm.counter("ndarray.asnumpy").value == base + 1
+    a.wait_to_read()
+    assert tm.counter("ndarray.wait_to_read").value == 1
+
+
+def test_speedometer_phase_breakdown(caplog):
+    import logging as _logging
+
+    from mxnet_tpu.callback import Speedometer
+
+    with tm.span("fit.dispatch"):
+        sum(range(1000))
+
+    class Param:
+        epoch, nbatch = 0, 1
+        eval_metric = None
+
+    s = Speedometer(batch_size=8, frequent=1, phases=True)
+    p = Param()
+    with caplog.at_level(_logging.INFO):
+        s(p)  # arms meter + phase window
+        with tm.span("fit.dispatch"):
+            sum(range(1000))
+        p.nbatch = 2
+        s(p)
+    assert any("Phases:" in r.message and "dispatch=" in r.message
+               for r in caplog.records)

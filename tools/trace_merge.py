@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Merge a host span trace and a device trace into one Chrome trace JSON.
+
+The host file comes from ``mxnet_tpu.telemetry.dump_trace`` (spans recorded
+under ``MXNET_TELEMETRY=1``); the device file from
+``mxnet_tpu.profiler.dump_profile`` (or a raw ``*.trace.json.gz`` out of the
+jax profiler logdir — gzip is handled transparently). The output loads in
+chrome://tracing or https://ui.perfetto.dev as ONE timeline: host rows are
+keyed by their own pid/tid and sit alongside the device rows.
+
+Standalone on purpose (stdlib only): merging two JSON files must not require
+importing the framework — usable on a laptop against traces scp'd off a TPU
+host.
+
+Usage:
+    python tools/trace_merge.py host_spans.json device_trace.json -o merged.json
+    python tools/trace_merge.py host_spans.json  # host-only passthrough
+"""
+
+import argparse
+import gzip
+import json
+import sys
+
+
+def load_trace(path):
+    """A chrome trace as a dict with a 'traceEvents' list (bare event-array
+    files are legal chrome JSON and get wrapped)."""
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        return {"traceEvents": data}
+    if not isinstance(data, dict):
+        raise SystemExit(f"{path}: not a chrome trace (got {type(data).__name__})")
+    return data
+
+
+def merge(host_path, device_path, out_path):
+    """Concatenate event lists; device-side metadata keys win (they carry
+    the profiler's clock/domain info)."""
+    merged = {"displayTimeUnit": "ms"}
+    events = []
+    if device_path:
+        dev = load_trace(device_path)
+        merged.update(dev)
+        events.extend(dev.get("traceEvents") or [])
+    host = load_trace(host_path)
+    events.extend(host.get("traceEvents") or [])
+    merged["traceEvents"] = events
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    return len(events)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("host", help="host span trace JSON (telemetry.dump_trace)")
+    ap.add_argument("device", nargs="?", default=None,
+                    help="device trace JSON[.gz] (profiler.dump_profile)")
+    ap.add_argument("-o", "--out", default="merged_trace.json",
+                    help="output path (default: merged_trace.json)")
+    args = ap.parse_args(argv)
+    n = merge(args.host, args.device, args.out)
+    print(f"{args.out}: {n} events", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
