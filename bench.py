@@ -29,6 +29,16 @@ additionally captures host spans + the jax device trace and writes one
 merged Perfetto-loadable timeline (``BENCH_TRACE_OUT``, default
 bench_trace.json) plus the snapshot JSON/Prometheus pair
 (``BENCH_TELEMETRY_OUT``, default bench_telemetry.json).
+
+Compile-cost trajectory: both modes report ``cold_compile_s`` (the first
+epoch / warmup duration — where XLA compilation lives) and
+``warm_start_s`` (a FRESH module bound and stepped once after the timed
+run), so the AOT executable cache win (``MXNET_AOT_CACHE=1`` — warm
+start deserializes instead of recompiling) is tracked by the bench
+trajectory, not just asserted in tests. ``BENCH_WARM_START=0`` skips the
+extra measurement. ``MXNET_TRAIN_WINDOW=auto`` in fit mode engages the
+adaptive window scheduler; the chosen K is reported as
+``train_window_k``.
 """
 
 import json
@@ -91,7 +101,33 @@ def _run_fit_mode(mx, mod, batch_size, image, dtype, iters, windows):
     rates = batch_size * iters / steady
     rate = float(np.median(rates))
     spread = float((rates.max() - rates.min()) / rate) if len(rates) > 1 else 0.0
-    return rate, spread
+    # the discarded first epoch is where XLA compilation lives — report it
+    # so the compile-cache win shows up in the bench trajectory
+    cold_compile_s = float(durations[0]) if len(durations) > 1 else 0.0
+    return rate, spread, cold_compile_s
+
+
+def _time_warm_start(mx, models, batch_size, image, dtype, num_layers,
+                     on_tpu, fused=1):
+    """Bind a FRESH module and run one dispatch (a `fused`-step window when
+    fused>1, matching the timed loop's program shape): with the ambient
+    MXNET_AOT_CACHE state this measures cache-deserialize vs recompile."""
+    mod = _build_module(mx, models, batch_size, image, dtype, num_layers,
+                        on_tpu)
+    rng = np.random.RandomState(1)
+    data = mx.nd.array(
+        rng.uniform(-1, 1, (batch_size,) + image).astype(np.float32),
+        dtype=dtype)
+    label = mx.nd.array(rng.randint(0, 1000, (batch_size,)).astype(np.float32))
+    batch = mx.io.DataBatch(data=[data], label=[label])
+    tic = time.time()
+    if fused > 1:
+        mod.train_window(batch, fused)
+    else:
+        mod.forward_backward(batch)
+        mod.update()
+    np.asarray(mod.get_outputs()[0]._data[0, :1])
+    return round(time.time() - tic, 3)
 
 
 def main():
@@ -127,7 +163,7 @@ def main():
         # _run_fit_mode resets telemetry again at the first epoch boundary
         # so the snapshot covers the steady-state epochs only
         mx.telemetry.reset()
-        img_per_sec, spread = _run_fit_mode(
+        img_per_sec, spread, cold_compile_s = _run_fit_mode(
             mx, mod, batch_size, image, dtype, max(iters, 2), max(windows, 2))
         record = {
             "metric": f"resnet{num_layers}_fit_throughput"
@@ -136,8 +172,12 @@ def main():
             "unit": "images/sec",
             "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
             "spread": round(spread, 4),
+            "cold_compile_s": round(cold_compile_s, 3),
             "telemetry": mx.telemetry.snapshot(),
         }
+        window_k = mx.telemetry.gauge("fit.train_window_k").value
+        if window_k:
+            record["train_window_k"] = window_k
         if tracing:
             device_trace = mx.profiler.dump_profile()  # stops the trace
             merged = mx.telemetry.merge_chrome_trace(
@@ -148,6 +188,11 @@ def main():
             record["telemetry_snapshot"] = snap_path
             print(f"merged trace: {merged}  snapshot: {snap_path} "
                   f"{prom_path}", file=sys.stderr)
+        # AFTER the trace dump: the fresh module's recompile must not
+        # pollute the steady-state timeline the trace documents
+        if os.environ.get("BENCH_WARM_START", "1") != "0":
+            record["warm_start_s"] = _time_warm_start(
+                mx, models, batch_size, image, dtype, num_layers, on_tpu)
         print(json.dumps(record))
         return
 
@@ -178,9 +223,12 @@ def main():
         np.asarray(mod.get_outputs()[0]._data[0, :1])
 
     # warmup in whole windows too: a trailing partial window would compile
-    # an extra program shape the timed region never uses
+    # an extra program shape the timed region never uses; its duration is
+    # where XLA compilation lives, reported as cold_compile_s
+    tic = time.time()
     run_steps(((max(warmup, 2 * fused) + fused - 1) // fused) * fused)
     fence()
+    cold_compile_s = round(time.time() - tic, 3)
     mx.telemetry.reset()  # snapshot covers the timed steady state only
 
     # several independently-timed windows: the reported value is the
@@ -207,8 +255,13 @@ def main():
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
         "spread": round(spread, 4),
+        "cold_compile_s": cold_compile_s,
         "telemetry": mx.telemetry.snapshot(),
     }
+    if os.environ.get("BENCH_WARM_START", "1") != "0":
+        record["warm_start_s"] = _time_warm_start(
+            mx, models, batch_size, image, dtype, num_layers, on_tpu,
+            fused=fused)
     if on_tpu and num_layers == 50 and dtype == "bfloat16":
         # MFU note: ResNet-50@224 train ≈ 3x fwd FLOPs ≈ 12.3 GFLOP/img.
         # Peak is per device kind (bf16); unknown kinds omit the field

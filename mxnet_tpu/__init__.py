@@ -87,6 +87,7 @@ from . import rnn
 from . import image
 from . import profiler
 from . import telemetry
+from . import aot
 from . import visualization
 from . import visualization as viz
 from . import test_utils

@@ -105,6 +105,25 @@ _declare("MXNET_PS_EXIT_TIMEOUT", float, 3600.0,
 _declare("MXNET_PS_MAX_FRAME", int, 1 << 31,
          "Upper bound in bytes on a single dist_async wire frame payload "
          "— a parse-time allocation guard on the typed tensor protocol.")
+_declare("MXNET_AOT_CACHE", _parse_bool, False,
+         "Persist AOT-compiled executables to disk (MXNET_AOT_CACHE_DIR) "
+         "and load them in later processes, keyed by program signature + "
+         "backend/jax/framework versions — a warm process binds and runs "
+         "with executor.jit_compile == 0. Off by default; enable in "
+         "deployments (tools/aot_warm.py pre-populates out of band). "
+         "Backends without executable serialization fall back to "
+         "trace-and-compile (aot.serialize_unsupported counts it).")
+_declare("MXNET_AOT_CACHE_DIR", str, "~/.cache/mxnet_tpu/aot",
+         "Directory for the persistent AOT executable cache "
+         "(~ expanded; created on first store).")
+_declare("MXNET_TRAIN_WINDOW", str, "",
+         "Fused-K step depth for Module.fit: an integer K dispatches "
+         "train_window(K) chunks; 'auto' probes a few single-step batches "
+         "and picks K from the measured dispatch-vs-residual telemetry "
+         "ratio (aot.choose_train_window) — deep windows on "
+         "dispatch-bound (tunneled) runtimes, K=1 when device/data-bound. "
+         "Windows move lr-schedule and metric updates to window "
+         "granularity. Empty (default) keeps the per-batch loop.")
 _declare("MXNET_XLA_TPU_OPTIONS", str, "",
          "Comma-separated key=value XLA compiler options attached to every "
          "executor program when the target is a TPU (ignored on CPU). The "

@@ -35,6 +35,7 @@ from .base import MXNetError, np_dtype
 from .context import Context, current_context
 from .ndarray import NDArray, ones as nd_ones, zeros as nd_zeros
 from .ops.registry import OpMode
+from . import aot as _aot
 from . import telemetry as _tm
 
 _GRAD_REQ = ("write", "add", "null")
@@ -316,6 +317,8 @@ class Executor:
         self._base_key = _random.next_key()
         self._jit_cache = {}
         self._fused_plan = {}  # (names, token, hg, treedef) -> (fn, idxs)
+        self._sig_cache = None  # memoized _jit_signature
+        self._sym_sha_cache = None  # memoized symbol-graph digest
         if shared_exec is not None:
             # bucketing: share compiled-function cache and memory with the
             # master executor (reference shared_exec data_pool_ reuse,
@@ -602,92 +605,236 @@ class Executor:
         self._step_dev = next_step
         self._step_dev_val = scheduled_val + 1
 
+    def _jit_signature(self):
+        """Memoized shape/dtype/grad signature of this executor's programs.
+
+        Rebuilding the (name, shape, str(dtype)) tuples for every arg on
+        every step costs real dispatch time at ResNet argument counts; the
+        signature can only change on rebind/reshape (both create a NEW
+        Executor), so it is computed once per executor. The ambient mesh is
+        deliberately NOT part of it — ``_get_jit`` adds ``current_mesh()``
+        per call, so mesh changes still key distinct programs.
+        """
+        sig = self._sig_cache
+        if sig is None:
+            small = self._small_state()
+            arg_pack = small["arg"] if small else None
+            aux_pack = small["aux"] if small else None
+            sig = (
+                tuple((n, self.arg_dict[n].shape, str(self.arg_dict[n].dtype))
+                      for n in self.arg_names),
+                tuple((n, self.aux_dict[n].shape, str(self.aux_dict[n].dtype))
+                      for n in self.aux_names),
+                tuple(self._wrt_names),
+                tuple(sorted((n, r) for n, r in self.grad_req.items())),
+                self._pack_fill(self.arg_names, arg_pack),
+                self._pack_fill(self.aux_names, aux_pack),
+            )
+            self._sig_cache = sig
+        return sig
+
+    def _sym_sha(self):
+        """Digest of the symbol graph itself — shapes alone cannot key a
+        cross-process executable cache (two graphs can share an argument
+        signature)."""
+        sha = self._sym_sha_cache
+        if sha is None:
+            import hashlib
+
+            h = hashlib.sha256(self._symbol.tojson().encode())
+            h.update(repr(sorted(self._symbol.attr_dict().items())).encode())
+            sha = h.hexdigest()
+            self._sym_sha_cache = sha
+        return sha
+
+    def _aot_digest(self, cache_key):
+        """Persistent-cache digest for a jit program, or None when it must
+        not persist: cache off, ambient mesh or sharded inputs (mesh
+        objects have no process-stable identity and sharded executables
+        are topology-bound in ways the fingerprint doesn't capture), or
+        interpret modes (their "programs" are python closures)."""
+        if not _aot.cache_enabled():
+            return None
+        if cache_key[-1] is not None or self._in_shardings or \
+                self._node2dev or self._naive:
+            return None
+        opts = _tpu_compiler_options(self._ctx)
+        dev = self._ctx.jax_device()
+        return _aot.digest(
+            "jit", self._sym_sha(), cache_key[:-1], self.graph.remat,
+            dev.platform, getattr(dev, "device_kind", ""),
+            tuple(sorted(opts.items())) if opts else (),
+        )
+
+    def _fused_aot_digest(self, plan_key, auto_layout):
+        """Persistent-cache digest for a fused train program, or None under
+        the same non-persistable conditions as :meth:`_aot_digest`. The
+        fused program's trace is determined by the graph + argument
+        signature plus the plan key (update set, optimizer token, state
+        tree structure, window depth, data-stack names) — state-leaf
+        shapes follow the parameter signature, and hyperparameters are
+        traced inputs."""
+        if not _aot.cache_enabled():
+            return None
+        if self._in_shardings or self._node2dev or self._naive:
+            return None
+        (update_names, cache_token, with_hg, state_td, has_handles,
+         sched_mesh, n_steps, stack_names) = plan_key
+        if sched_mesh is not None:
+            return None
+        opts = _tpu_compiler_options(self._ctx)
+        dev = self._ctx.jax_device()
+        return _aot.digest(
+            "fused", self._sym_sha(), self._jit_signature(),
+            (update_names, cache_token, with_hg, repr(state_td),
+             has_handles, n_steps, stack_names),
+            auto_layout, self.graph.remat, dev.platform,
+            getattr(dev, "device_kind", ""),
+            tuple(sorted(opts.items())) if opts else (),
+        )
+
     def _get_jit(self, kind, is_train=False, with_head_grads=False):
-        """Build (lazily) the jitted program for this graph shape-signature."""
+        """Build (lazily) the jitted program for this graph shape-signature.
+
+        Jitted programs come back wrapped in :class:`aot.AOTProgram`:
+        ``lower().compile()``d on first call (or deserialized from the
+        persistent cache under ``MXNET_AOT_CACHE``) and invoked as concrete
+        executables from then on — ``executor.jit_compile`` counts actual
+        XLA compiles, so a warm-cache process runs at 0.
+        """
         import jax
 
         from .parallel.mesh import current_mesh
 
+        # ops may bake the ambient mesh into the trace (RingAttention's
+        # shard_map); a program traced under one mesh context must not
+        # be served under another
+        cache_key = (kind, is_train, with_head_grads, self._jit_signature(),
+                     current_mesh())
+        fn = self._jit_cache.get(cache_key)
+        if fn is not None:
+            _tm.counter("executor.jit_cache_hit").inc()
+            return fn
         small = self._small_state()
         arg_pack = small["arg"] if small else None
         aux_pack = small["aux"] if small else None
         arg_fill = self._pack_fill(self.arg_names, arg_pack)
         aux_fill = self._pack_fill(self.aux_names, aux_pack)
-        cache_key = (
-            kind,
-            is_train,
-            with_head_grads,
-            tuple((n, self.arg_dict[n].shape, str(self.arg_dict[n].dtype)) for n in self.arg_names),
-            tuple((n, self.aux_dict[n].shape, str(self.aux_dict[n].dtype)) for n in self.aux_names),
-            tuple(self._wrt_names),
-            tuple(sorted((n, r) for n, r in self.grad_req.items())),
-            arg_fill, aux_fill,
-            # ops may bake the ambient mesh into the trace (RingAttention's
-            # shard_map); a program traced under one mesh context must not
-            # be served under another
-            current_mesh(),
-        )
-        fn = self._jit_cache.get(cache_key)
-        if fn is not None:
-            _tm.counter("executor.jit_cache_hit").inc()
-            return fn
-        # a miss here means a new XLA program for this graph/shape/mesh
-        # signature — recompiles in steady state are a perf bug worth
-        # surfacing (the reference's cached-op cache-miss analogue)
-        _tm.counter("executor.jit_compile").inc()
-        with _tm.span("executor.jit_build", kind=kind):
-            graph = self.graph
+        graph = self.graph
 
-            if kind == "forward":
+        if kind == "forward":
 
-                def _fwd(arg_vals, arg_flat, aux_vals, aux_flat, rng):
-                    full_args = _fill_packed(arg_vals, arg_flat, arg_fill)
-                    full_aux = _fill_packed(aux_vals, aux_flat, aux_fill)
-                    outs, aux_upd = graph.evaluate(
-                        full_args, full_aux, _fold_rng(rng), is_train
-                    )
-                    aux_big, aux_flat_out = _split_out(aux_upd, aux_fill)
-                    return outs, aux_big, aux_flat_out, _next_step(rng)
-
-                fn = _fwd if (self._node2dev or self._naive) else jax.jit(
-                    _fwd, compiler_options=_tpu_compiler_options(self._ctx)
+            def _fwd(arg_vals, arg_flat, aux_vals, aux_flat, rng):
+                full_args = _fill_packed(arg_vals, arg_flat, arg_fill)
+                full_aux = _fill_packed(aux_vals, aux_flat, aux_fill)
+                outs, aux_upd = graph.evaluate(
+                    full_args, full_aux, _fold_rng(rng), is_train
                 )
-            elif kind == "train_step":
-                core = self._make_grad_core()
-                grad_names = tuple(arg_pack["names"]) if arg_pack else ()
+                aux_big, aux_flat_out = _split_out(aux_upd, aux_fill)
+                return outs, aux_big, aux_flat_out, _next_step(rng)
 
-                def _tstep(arg_vals, arg_flat, aux_vals, aux_flat, rng, heads,
-                           prev):
-                    import jax.numpy as jnp
+            traced = _fwd
+        elif kind == "train_step":
+            core = self._make_grad_core()
+            grad_names = tuple(arg_pack["names"]) if arg_pack else ()
 
-                    full_args = _fill_packed(arg_vals, arg_flat, arg_fill)
-                    full_aux = _fill_packed(aux_vals, aux_flat, aux_fill)
-                    outs, aux_upd, grad_map = core(
-                        full_args, full_aux, rng, heads, prev
-                    )
-                    aux_big, aux_flat_out = _split_out(aux_upd, aux_fill)
-                    grad_flat = None
-                    if grad_names:
-                        grad_map = dict(grad_map)
-                        grad_flat = jnp.concatenate([
-                            grad_map.pop(n).astype(jnp.float32).ravel()
-                            for n in grad_names
-                        ])
-                    return (outs, aux_big, aux_flat_out, grad_map, grad_flat,
-                            _next_step(rng))
+            def _tstep(arg_vals, arg_flat, aux_vals, aux_flat, rng, heads,
+                       prev):
+                import jax.numpy as jnp
 
-                # ctx-group placement spans devices: XLA compiles
-                # single-device (or SPMD-sharded) programs only, so a
-                # placed graph executes eagerly — per-op dispatch on the
-                # op's device, like the reference engine's per-device
-                # worker queues
-                fn = _tstep if (self._node2dev or self._naive) else jax.jit(
-                    _tstep, compiler_options=_tpu_compiler_options(self._ctx)
+                full_args = _fill_packed(arg_vals, arg_flat, arg_fill)
+                full_aux = _fill_packed(aux_vals, aux_flat, aux_fill)
+                outs, aux_upd, grad_map = core(
+                    full_args, full_aux, rng, heads, prev
                 )
-            else:
-                raise MXNetError(f"unknown jit kind {kind}")
+                aux_big, aux_flat_out = _split_out(aux_upd, aux_fill)
+                grad_flat = None
+                if grad_names:
+                    grad_map = dict(grad_map)
+                    grad_flat = jnp.concatenate([
+                        grad_map.pop(n).astype(jnp.float32).ravel()
+                        for n in grad_names
+                    ])
+                return (outs, aux_big, aux_flat_out, grad_map, grad_flat,
+                        _next_step(rng))
+
+            traced = _tstep
+        else:
+            raise MXNetError(f"unknown jit kind {kind}")
+
+        if self._node2dev or self._naive:
+            # ctx-group placement spans devices: XLA compiles single-device
+            # (or SPMD-sharded) programs only, so a placed graph executes
+            # eagerly — per-op dispatch on the op's device, like the
+            # reference engine's per-device worker queues. NaiveEngine
+            # interprets synchronously. Either way this IS the "compile"
+            # for the signature (the cached-op cache-miss analogue).
+            _tm.counter("executor.jit_compile").inc()
+            fn = traced
+        else:
+            fn = _aot.AOTProgram(
+                jax.jit(traced,
+                        compiler_options=_tpu_compiler_options(self._ctx)),
+                key_digest=self._aot_digest(cache_key),
+                # a real XLA compile in steady state is a perf bug worth
+                # surfacing; deserialized warm starts don't count
+                compile_counter="executor.jit_compile",
+                compile_span="executor.jit_build",
+            )
         self._jit_cache[cache_key] = fn
         return fn
+
+    def compile(self, kinds=None):
+        """AOT-compile this executor's programs without executing them.
+
+        The jax production warmup recipe (``lower().compile()``): each
+        requested program is compiled — or deserialized from the
+        persistent cache under ``MXNET_AOT_CACHE`` — so the first real
+        step pays no XLA wait, and with the cache enabled every later
+        process with the same signature starts at
+        ``executor.jit_compile == 0`` (``tools/aot_warm.py`` drives this
+        out of band). XLA compilation releases the GIL, so callers may
+        warm several executors from threads
+        (``BucketingModule.compile``).
+
+        ``kinds`` ⊆ {"forward", "forward_train", "train_step"}; None warms
+        eval forward, plus train forward and the fused fwd+bwd program
+        when the executor computes gradients and the graph has a loss head
+        (a head-grad-less train_step on a loss-free graph is a trace-time
+        error, not a warmable program). Returns the kinds compiled;
+        interpret modes (monitor / NaiveEngine / ctx-group placement) have
+        no XLA program and return [].
+        """
+        if self._node2dev or self._naive or \
+                self._monitor_callback is not None:
+            return []
+        if kinds is None:
+            kinds = ["forward"]
+            if self._wrt_names:
+                kinds.append("forward_train")
+                if any(_head_loss_flags(self.graph)):
+                    kinds.append("train_step")
+        args_in, args_flat = self._arg_vals_split()
+        aux_in, aux_flat = self._aux_vals_split()
+        rng = self._rng_key()
+        done = []
+        for kind in kinds:
+            if kind in ("forward", "forward_train"):
+                prog = self._get_jit(
+                    "forward", is_train=(kind == "forward_train"))
+                args = (args_in, args_flat, aux_in, aux_flat, rng)
+            elif kind == "train_step":
+                prog = self._get_jit("train_step")
+                prev = {n: self.grad_dict[n]._data for n in self._wrt_names
+                        if self.grad_req[n] == "add"}
+                args = (args_in, args_flat, aux_in, aux_flat, rng, None,
+                        prev)
+            else:
+                raise MXNetError(f"unknown compile kind {kind!r}")
+            ensure = getattr(prog, "ensure_compiled", None)
+            if ensure is not None and ensure(args):
+                done.append(kind)
+        return done
 
     @staticmethod
     def _pack_fill(order, pack):
@@ -1384,11 +1531,28 @@ class Executor:
         dispatched = False
         try:
             with with_mesh(sched_mesh):
+                pdigest = None
                 if aot[0] is None:
                     # ahead-of-time compile once, then call the executable
                     # directly: the jit re-dispatch machinery (cache lookup,
                     # arg inference) costs real milliseconds per step at
-                    # this argument count
+                    # this argument count. The persistent cache
+                    # (MXNET_AOT_CACHE) serves the executable across
+                    # processes — warm starts skip the XLA compile.
+                    pdigest = self._fused_aot_digest(plan_key, auto_layout)
+                    loaded = _aot.load(pdigest)
+                    if loaded is not None:
+                        if auto_layout:
+                            try:
+                                aot[1] = jax.tree_util.tree_leaves(
+                                    loaded.input_formats
+                                )
+                                aot[0] = loaded
+                            except Exception:
+                                pass  # formats unreadable: compile fresh
+                        else:
+                            aot[0] = loaded
+                if aot[0] is None:
                     if auto_layout:
                         # AUTO rejects concrete arrays (their layouts are
                         # already pinned): lower from avals, then convert
@@ -1422,6 +1586,7 @@ class Executor:
                             aot[0] = plain.lower(*call_args).compile()
                     else:
                         aot[0] = fn.lower(*call_args).compile()
+                    _aot.store(pdigest, aot[0])
                 if aot[1] is not None:
                     # donated steady-state buffers already carry the
                     # compiled formats (they are last window's outputs);

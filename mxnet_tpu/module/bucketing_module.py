@@ -19,6 +19,7 @@ import warnings
 
 from ..base import MXNetError
 from ..initializer import Uniform
+from .. import telemetry as _tm
 from .base_module import BaseModule, _check_input_names
 from .module import Module
 
@@ -172,9 +173,19 @@ class BucketingModule(BaseModule):
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
         """Bind (or reuse) the module for ``bucket_key``
-        (reference bucketing_module.py:307+)."""
+        (reference bucketing_module.py:307+).
+
+        Telemetry mirrors the ``executor.jit_compile`` invariant:
+        ``bucketing.switch`` counts every change of the active bucket and
+        ``bucketing.compile_on_switch`` counts switches that had to bind
+        (and later compile) a NEW bucket — steady-state bucket-miss
+        recompiles are a perf bug worth surfacing.
+        """
         assert self.binded, "call bind before switching bucket"
+        if bucket_key != self._curr_bucket_key:
+            _tm.counter("bucketing.switch").inc()
         if bucket_key not in self._buckets:
+            _tm.counter("bucketing.compile_on_switch").inc()
             symbol, data_names, label_names = self._sym_gen(bucket_key)
             module = Module(
                 symbol, data_names, label_names, logger=self.logger,
@@ -207,6 +218,43 @@ class BucketingModule(BaseModule):
             if mod is not self._curr_module:
                 mod.borrow_optimizer(self._curr_module)
         self.optimizer_initialized = True
+
+    def compile(self, buckets=None, parallel=True):
+        """Pre-compile bucket programs ahead of the data (the warmup /
+        cache-population recipe for bucketed models).
+
+        ``buckets``: iterable of ``(bucket_key, data_shapes, label_shapes)``
+        to bind first (the shapes a ``switch_bucket`` for that key would
+        see); None warms only the already-bound buckets. Each bucket's
+        executor is then ``Executor.compile``d — in a thread pool when
+        ``parallel`` (XLA compilation releases the GIL, so N buckets
+        compile concurrently), which with ``MXNET_AOT_CACHE=1`` also
+        populates the persistent executable cache. The active bucket is
+        restored. Returns ``{bucket_key: [kinds compiled]}``.
+        """
+        assert self.binded, "call bind before compiling buckets"
+        original_key = self._curr_bucket_key
+        for spec in buckets or ():
+            key, data_shapes, label_shapes = spec
+            self.switch_bucket(key, data_shapes, label_shapes)
+        self.switch_bucket(original_key, None, None)
+        items = list(self._buckets.items())
+
+        def warm(mod):
+            return mod._exec_group._exec.compile()
+
+        if parallel and len(items) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            import os as _os
+
+            with ThreadPoolExecutor(
+                max_workers=min(len(items), _os.cpu_count() or 1)
+            ) as pool:
+                compiled = list(pool.map(lambda kv: warm(kv[1]), items))
+        else:
+            compiled = [warm(mod) for _key, mod in items]
+        return {key: kinds for (key, _mod), kinds in zip(items, compiled)}
 
     def prepare(self, data_batch):
         assert self.binded and self.params_initialized

@@ -25,6 +25,10 @@ dispatch-bound or sync-bound. This module is the host half:
 Instrumented hot paths (see docs/observability.md for the full catalog):
 ``io.prefetch.*`` (DevicePrefetchIter), ``fit.*``/``score.*`` (Module
 epoch loops), ``executor.jit_*``/``executor.fused_plan_*`` (compile cache),
+``aot.*`` (persistent executable cache: cache_hit/cache_miss/cache_store
+counters, deserialize/serialize/compile spans — mxnet_tpu.aot),
+``bucketing.switch``/``bucketing.compile_on_switch`` (bucket-miss
+recompiles), the ``fit.train_window_k`` gauge (adaptive window depth),
 ``kvstore.*``/``kvstore_async.*`` (push/pull/bytes/barrier),
 ``metric.*`` (device vs numpy-fallback accumulation, drain syncs) and
 ``ndarray.asnumpy``/``ndarray.wait_to_read`` (every host-blocking sync).

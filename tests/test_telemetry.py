@@ -280,3 +280,45 @@ def test_speedometer_phase_breakdown(caplog):
         s(p)
     assert any("Phases:" in r.message and "dispatch=" in r.message
                for r in caplog.records)
+
+
+def test_bucketing_switch_counters():
+    """switch_bucket mirrors the executor.jit_compile invariant:
+    bucketing.switch counts active-bucket changes, and
+    bucketing.compile_on_switch counts only switches that had to BIND a
+    new bucket — steady-state bucket misses must read as zero."""
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=10, output_dim=6, name="emb")
+        pooled = mx.sym.sum(emb, axis=1)
+        net = mx.sym.FullyConnected(pooled, num_hidden=4, name="fc")
+        return mx.sym.SoftmaxOutput(net, name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    tm.reset()
+    for key, dshape in [(8, (4, 8)), (4, (4, 4)), (8, (4, 8)), (4, (4, 4))]:
+        batch = mx.io.DataBatch(
+            data=[mx.nd.ones(dshape)], label=[mx.nd.zeros((4,))],
+            bucket_key=key,
+            provide_data=[mx.io.DataDesc("data", dshape)],
+            provide_label=[mx.io.DataDesc("softmax_label", (4,))],
+        )
+        mod.forward(batch, is_train=False)
+    # 8->4, 4->8, 8->4: three active-bucket changes, ONE new bucket bound
+    assert tm.counter("bucketing.switch").value == 3
+    assert tm.counter("bucketing.compile_on_switch").value == 1
+    # steady state: revisiting bound buckets binds nothing new
+    compile_before = tm.counter("bucketing.compile_on_switch").value
+    for key, dshape in [(8, (4, 8)), (4, (4, 4))]:
+        batch = mx.io.DataBatch(
+            data=[mx.nd.ones(dshape)], label=[mx.nd.zeros((4,))],
+            bucket_key=key,
+            provide_data=[mx.io.DataDesc("data", dshape)],
+            provide_label=[mx.io.DataDesc("softmax_label", (4,))],
+        )
+        mod.forward(batch, is_train=False)
+    assert tm.counter("bucketing.compile_on_switch").value == compile_before
