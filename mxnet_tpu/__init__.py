@@ -80,6 +80,9 @@ from . import kvstore as kv
 from . import callback
 from . import monitor
 from . import model
+from . import checkpoint
+from .checkpoint import CheckpointConfig
+from . import faultinject
 from .model import FeedForward
 from . import module
 from . import module as mod

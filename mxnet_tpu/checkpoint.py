@@ -1,0 +1,590 @@
+"""Crash-consistent checkpointing and auto-resume.
+
+The reference framework's recovery story is launcher-level whole-job
+restart (ps-lite dead-node detection, ``src/kvstore/kvstore_dist.h:177-185``
+→ here ``tools/launch.py --max-restarts``) — but a restart used to begin
+again from epoch 0 because ``save_checkpoint`` wrote params non-atomically
+with no optimizer or iterator state. This module is the durable half of
+fault tolerance:
+
+* **Atomic file commits** — :func:`atomic_path` writes to a temp file in
+  the target directory, fsyncs, then ``os.replace``\\ s into place and
+  fsyncs the directory, so a crash mid-write can never leave a torn final
+  file. Every param/state writer in the framework
+  (``model.save_checkpoint``, ``Module.save_checkpoint``,
+  ``callback.do_checkpoint``) routes through it.
+
+* **Manifested checkpoints** — :class:`CheckpointManager` writes one
+  *directory* per checkpoint: params, optimizer state, symbol JSON and a
+  ``manifest.json`` (epoch/batch cursor, per-file sha256 digests, RNG key,
+  optimizer update counts, environment fingerprint). The manifest is
+  written last and the directory is renamed into place, so a checkpoint
+  either exists completely or not at all. A ``LATEST`` pointer file names
+  the newest commit; ``keep_n`` retention prunes old ones.
+
+* **Digest-verified load with fallback** — :meth:`CheckpointManager.
+  load_latest` verifies every file against the manifest digests; a
+  truncated or corrupted checkpoint is *never* loaded — it is counted
+  (``checkpoint.corrupt``), logged, and the previous manifest-valid
+  checkpoint is used instead (``checkpoint.fallback``).
+
+* **Auto-resume** — ``Module.fit(..., checkpoint=CheckpointConfig(dir))``
+  (or ``MXNET_CHECKPOINT_DIR``) saves every ``period`` epochs (and every
+  ``batch_period`` batches mid-epoch) and, on the next fit in a fresh
+  process, resumes epoch / batch cursor / params / optimizer state / RNG
+  from the latest valid checkpoint — so ``tools/launch.py --max-restarts``
+  relaunches continue mid-training instead of from scratch.
+
+Multi-host: only rank 0 writes (``dist`` kvstores gate on ``kv.rank``),
+fenced by barriers so no rank races ahead of a commit; every rank loads
+the same checkpoint from the shared directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import shutil
+
+from . import telemetry as _tm
+from .base import MXNetError
+
+_MANIFEST = "manifest.json"
+_LATEST = "LATEST"
+_FORMAT = 1
+
+
+class CheckpointCorrupt(MXNetError):
+    """A checkpoint failed digest/manifest verification."""
+
+
+# --- atomic file primitives -------------------------------------------------
+
+def _fsync_dir(path):
+    """fsync a directory so a rename inside it is durable (best-effort on
+    filesystems that refuse O_RDONLY dir fsync, e.g. some network mounts)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _fsync_file(path):
+    with open(path, "rb+") as f:
+        os.fsync(f.fileno())
+
+
+@contextlib.contextmanager
+def atomic_path(final_path, fsync=True):
+    """Yield a temp path in ``final_path``'s directory; on clean exit fsync
+    it, ``os.replace`` it over ``final_path`` and fsync the directory. On
+    exception the temp file is removed and the final path is untouched —
+    a crash mid-write can never leave a torn final file."""
+    final_path = os.fspath(final_path)
+    d = os.path.dirname(os.path.abspath(final_path))
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(
+        d, f".tmp-{os.path.basename(final_path)}.{os.getpid()}"
+    )
+    try:
+        yield tmp
+        if fsync:
+            _fsync_file(tmp)
+        os.replace(tmp, final_path)
+        if fsync:
+            _fsync_dir(d)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def atomic_write_bytes(path, data, fsync=True):
+    """Atomically write ``data`` (bytes or str) to ``path``."""
+    mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+    with atomic_path(path, fsync=fsync) as tmp:
+        with open(tmp, mode) as f:
+            f.write(data)
+    return path
+
+
+def sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _env_fingerprint():
+    """Environment identity recorded in every manifest — a resume under a
+    different compiler/backend is legal but worth a warning (numerics can
+    drift). Reuses the AOT cache's fingerprint; falls back to a minimal
+    tuple when jax is unavailable (pure file-tool use)."""
+    try:
+        from . import aot as _aot
+
+        return repr(_aot._fingerprint())
+    except Exception:
+        from .base import __version__
+
+        return repr(("no-jax", __version__))
+
+
+# --- configuration ----------------------------------------------------------
+
+class CheckpointConfig:
+    """Checkpointing policy for ``Module.fit``.
+
+    Parameters
+    ----------
+    dir : str
+        Checkpoint root directory (created on first save).
+    period : int
+        Save every ``period`` epochs (default 1).
+    keep_n : int
+        Retain the newest ``keep_n`` checkpoints (default 3; ``0`` keeps
+        everything).
+    batch_period : int
+        Additionally save every ``batch_period`` batches mid-epoch
+        (default 0 = epoch boundaries only).
+    save_optimizer : bool
+        Save optimizer state alongside params (default True).
+    resume : bool
+        Resume from the latest valid checkpoint at fit start
+        (default True).
+    """
+
+    __slots__ = ("dir", "period", "keep_n", "batch_period",
+                 "save_optimizer", "resume")
+
+    def __init__(self, dir, period=1, keep_n=3, batch_period=0,
+                 save_optimizer=True, resume=True):
+        self.dir = os.fspath(dir)
+        self.period = max(1, int(period))
+        self.keep_n = max(0, int(keep_n))
+        self.batch_period = max(0, int(batch_period))
+        self.save_optimizer = bool(save_optimizer)
+        self.resume = bool(resume)
+
+    @staticmethod
+    def from_env():
+        """Config from ``MXNET_CHECKPOINT_*`` (None when no dir is set) —
+        lets ``tools/launch.py``-supervised jobs enable checkpoint/resume
+        without touching the training script."""
+        from . import env as _env
+
+        d = _env.get("MXNET_CHECKPOINT_DIR")
+        if not d:
+            return None
+        return CheckpointConfig(
+            d,
+            period=_env.get("MXNET_CHECKPOINT_PERIOD"),
+            keep_n=_env.get("MXNET_CHECKPOINT_KEEP"),
+            batch_period=_env.get("MXNET_CHECKPOINT_BATCH_PERIOD"),
+        )
+
+    @staticmethod
+    def coerce(value):
+        """Normalise a fit ``checkpoint=`` argument: a config passes
+        through, a string is a directory, None consults the env."""
+        if value is None:
+            return CheckpointConfig.from_env()
+        if isinstance(value, CheckpointConfig):
+            return value
+        if isinstance(value, (str, os.PathLike)):
+            return CheckpointConfig(value)
+        raise TypeError(
+            "checkpoint must be a CheckpointConfig, a directory path or "
+            f"None, got {type(value).__name__}"
+        )
+
+
+class LoadedCheckpoint:
+    """A verified checkpoint, ready to resume from."""
+
+    __slots__ = ("path", "manifest", "arg_params", "aux_params",
+                 "opt_states_path")
+
+    def __init__(self, path, manifest, arg_params, aux_params,
+                 opt_states_path):
+        self.path = path
+        self.manifest = manifest
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.opt_states_path = opt_states_path
+
+    @property
+    def next_epoch(self):
+        return int(self.manifest["next_epoch"])
+
+    @property
+    def next_batch(self):
+        return int(self.manifest["next_batch"])
+
+
+# --- the manager ------------------------------------------------------------
+
+class CheckpointManager:
+    """Writes, verifies and restores manifested checkpoints for a module.
+
+    Construction is cheap and jax-free; the module/kvstore are attached by
+    ``Module.fit`` once the optimizer exists. Standalone use (tools, tests)
+    can call :meth:`save`/:meth:`load_latest` directly.
+    """
+
+    def __init__(self, config, module=None, logger=None):
+        self.config = config
+        self.module = module
+        self.kvstore = None
+        self.logger = logger or logging.getLogger("mxnet_tpu.checkpoint")
+        self._saves = 0
+        self._batch_mark = (None, 0)  # (epoch, nbatch at last batch save)
+
+    # -- rank gating ---------------------------------------------------
+    def attach(self, module, kvstore=None):
+        self.module = module
+        self.kvstore = kvstore
+        if (self.config.batch_period and kvstore is not None
+                and "dist" in getattr(kvstore, "type", "")
+                and getattr(kvstore, "num_workers", 1) > 1):
+            # mid-epoch saves are barrier-fenced collectives; ranks can
+            # tick nbatch asymmetrically (adaptive per-rank window depth,
+            # uneven shards), and a rank calling save() when its peers
+            # don't pairs its barrier with their gradient all-reduce —
+            # hang or corruption. Epoch boundaries are the one place all
+            # ranks are provably aligned.
+            self.logger.warning(
+                "checkpoint: MXNET_CHECKPOINT_BATCH_PERIOD disabled under "
+                "a multi-worker dist kvstore (rank-asymmetric batch ticks "
+                "would desynchronize the barrier-fenced save); "
+                "checkpointing at epoch boundaries only")
+            self.config.batch_period = 0
+
+    def _is_writer(self):
+        kv = self.kvstore
+        if kv is not None and "dist" in getattr(kv, "type", ""):
+            return kv.rank == 0
+        return True
+
+    def _fence(self):
+        """Barrier so no rank races past a rank-0 commit (and no rank
+        starts reading while rank 0 is mid-commit)."""
+        kv = self.kvstore
+        if kv is not None and "dist" in getattr(kv, "type", ""):
+            kv.barrier()
+
+    # -- periodic hooks (called from Module.fit) -----------------------
+    def epoch_tick(self, epoch):
+        """End-of-epoch hook: save when the period fires."""
+        if (epoch + 1) % self.config.period == 0:
+            self.save(next_epoch=epoch + 1, next_batch=0,
+                      epoch=epoch, nbatch=None)
+
+    def batch_tick(self, epoch, nbatch):
+        """Mid-epoch hook after ``nbatch`` completed batches. Fires on
+        CROSSING a ``batch_period`` boundary since the last save, not on
+        exact divisibility — train windows advance nbatch by K per
+        dispatch, so multiples of the period can be skipped over."""
+        bp = self.config.batch_period
+        if not bp or not nbatch:
+            return
+        mark_epoch, mark_batch = self._batch_mark
+        if mark_epoch != epoch:
+            mark_batch = 0
+        if nbatch // bp > mark_batch // bp:
+            self._batch_mark = (epoch, nbatch)
+            self.save(next_epoch=epoch, next_batch=nbatch,
+                      epoch=epoch, nbatch=nbatch)
+
+    # -- save ----------------------------------------------------------
+    def _collect_optimizer_meta(self):
+        opt = getattr(self.module, "_optimizer", None)
+        if opt is None:
+            return None
+        return {
+            "num_update": int(getattr(opt, "num_update", 0)),
+            "begin_num_update": int(getattr(opt, "begin_num_update", 0)),
+            "index_update_count": {
+                str(k): int(v)
+                for k, v in getattr(opt, "_index_update_count", {}).items()
+            },
+        }
+
+    def _rng_state(self):
+        try:
+            from . import random as _rand
+
+            return _rand.get_state()
+        except Exception:
+            return None
+
+    def save(self, next_epoch, next_batch, epoch=None, nbatch=None):
+        """Commit one crash-consistent checkpoint at resume position
+        ``(next_epoch, next_batch)``. All ranks call this (it fences);
+        only the writer rank touches the filesystem. Returns the committed
+        directory path on the writer, None elsewhere."""
+        self._fence()
+        out = None
+        if self._is_writer():
+            out = self._write(next_epoch, next_batch, epoch, nbatch)
+        self._fence()
+        return out
+
+    def _write(self, next_epoch, next_batch, epoch, nbatch):
+        from .ndarray import save as nd_save
+
+        mod = self.module
+        cfg = self.config
+        with _tm.span("checkpoint.write"):
+            arg_params, aux_params = mod.get_params()
+            name = f"ckpt-e{next_epoch:05d}-b{next_batch:08d}"
+            root = cfg.dir
+            os.makedirs(root, exist_ok=True)
+            tmp = os.path.join(root, f".tmp-{name}.{os.getpid()}")
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            files = {}
+
+            save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+            save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+            ppath = os.path.join(tmp, "params")
+            nd_save(ppath, save_dict)
+            _fsync_file(ppath)
+            files["params"] = {"sha256": sha256_file(ppath),
+                               "bytes": os.path.getsize(ppath)}
+
+            if cfg.save_optimizer and getattr(
+                    mod, "optimizer_initialized", False) and \
+                    hasattr(mod, "save_optimizer_states"):
+                spath = os.path.join(tmp, "optimizer.states")
+                try:
+                    mod.save_optimizer_states(spath)
+                except (AssertionError, MXNetError) as e:
+                    self.logger.warning(
+                        "checkpoint: optimizer state not saved (%s); "
+                        "resume will rebuild it fresh", e)
+                else:
+                    _fsync_file(spath)
+                    files["optimizer.states"] = {
+                        "sha256": sha256_file(spath),
+                        "bytes": os.path.getsize(spath),
+                    }
+
+            sym = getattr(mod, "symbol", None)
+            if sym is not None:
+                sympath = os.path.join(tmp, "symbol.json")
+                sym.save(sympath)
+                _fsync_file(sympath)
+                files["symbol.json"] = {"sha256": sha256_file(sympath),
+                                        "bytes": os.path.getsize(sympath)}
+
+            manifest = {
+                "format": _FORMAT,
+                "next_epoch": int(next_epoch),
+                "next_batch": int(next_batch),
+                "epoch": epoch,
+                "nbatch": nbatch,
+                "files": files,
+                "rng_key": self._rng_state(),
+                "optimizer": self._collect_optimizer_meta(),
+                "env": _env_fingerprint(),
+            }
+            # manifest last: its presence marks the directory complete
+            mpath = os.path.join(tmp, _MANIFEST)
+            with open(mpath, "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+            _fsync_file(mpath)
+            _fsync_dir(tmp)
+
+            final = os.path.join(root, name)
+            aside = None
+            if os.path.exists(final):
+                # re-save at the same cursor (rollback / replayed epoch):
+                # move the old commit ASIDE first — deleting it before the
+                # new rename lands would open a window where a crash loses
+                # the only checkpoint. Aside dirs are still loadable as a
+                # last resort (load_latest) until the swap completes.
+                aside = os.path.join(root, ".old-" + name)
+                if os.path.exists(aside):
+                    shutil.rmtree(aside)
+                os.rename(final, aside)
+            os.rename(tmp, final)
+            _fsync_dir(root)
+            if aside is not None:
+                shutil.rmtree(aside, ignore_errors=True)
+            atomic_write_bytes(os.path.join(root, _LATEST), name + "\n")
+            self._saves += 1
+            _tm.counter("checkpoint.save").inc()
+            _tm.counter("checkpoint.bytes").inc(
+                sum(f["bytes"] for f in files.values()))
+            self.logger.info("Saved checkpoint %s (resume at epoch %d "
+                             "batch %d)", final, next_epoch, next_batch)
+            self._retain(root)
+            # deterministic corruption hook for the robustness tests
+            from . import faultinject as _fi
+
+            _fi.post_checkpoint_commit(os.path.join(final, "params"))
+        return final
+
+    def _retain(self, root):
+        keep = self.config.keep_n
+        if not keep:
+            return
+        names = sorted(n for n in os.listdir(root)
+                       if n.startswith("ckpt-"))
+        for n in names[:-keep]:
+            with contextlib.suppress(OSError):
+                shutil.rmtree(os.path.join(root, n))
+                self.logger.info("Pruned checkpoint %s (keep_n=%d)",
+                                 n, keep)
+
+    # -- load ----------------------------------------------------------
+    def load_latest(self):
+        """The newest digest-valid checkpoint, or None.
+
+        Corrupt candidates (torn params, bad manifest) are skipped with a
+        warning — the previous valid checkpoint wins. Counted in
+        ``checkpoint.corrupt`` / ``checkpoint.fallback``."""
+        return load_latest(self.config.dir, logger=self.logger)
+
+    # -- restore -------------------------------------------------------
+    def restore(self, loaded, module=None):
+        """Push a loaded checkpoint's params + optimizer state + RNG into
+        ``module`` (used for both fit-start resume and the non-finite
+        guard's rollback escalation)."""
+        mod = module or self.module
+        mod.set_params(loaded.arg_params, loaded.aux_params,
+                       allow_missing=False, force_init=True)
+        self.restore_optimizer(loaded, mod)
+        _tm.counter("checkpoint.restore").inc()
+
+    def restore_optimizer(self, loaded, module=None):
+        """Restore optimizer state/update counts and the RNG key (the part
+        of resume that must run AFTER init_optimizer)."""
+        mod = module or self.module
+        if not getattr(mod, "optimizer_initialized", False):
+            return
+        if loaded.opt_states_path is not None and \
+                hasattr(mod, "load_optimizer_states"):
+            try:
+                mod.load_optimizer_states(loaded.opt_states_path)
+            except (AssertionError, MXNetError, OSError) as e:
+                self.logger.warning(
+                    "checkpoint: optimizer state not restored (%s); "
+                    "momentum/variance restart fresh", e)
+        meta = loaded.manifest.get("optimizer")
+        opt = getattr(mod, "_optimizer", None)
+        if meta and opt is not None:
+            opt.num_update = int(meta.get("num_update", 0))
+            opt.begin_num_update = int(meta.get("begin_num_update", 0))
+            counts = meta.get("index_update_count") or {}
+            opt._index_update_count = {
+                (int(k) if k.lstrip("-").isdigit() else k): int(v)
+                for k, v in counts.items()
+            }
+        rng = loaded.manifest.get("rng_key")
+        if rng is not None:
+            try:
+                from . import random as _rand
+
+                _rand.set_state(rng)
+            except Exception:
+                self.logger.warning(
+                    "checkpoint: RNG state not restored; stochastic ops "
+                    "resume from a fresh key")
+
+
+def load_latest(directory, logger=None):
+    """Module-level loader (what ``CheckpointManager.load_latest`` and the
+    tests use): newest digest-valid checkpoint under ``directory`` or
+    None, falling back past corrupt entries."""
+    log = logger or logging.getLogger("mxnet_tpu.checkpoint")
+    if not os.path.isdir(directory):
+        return None
+    candidates = []
+    latest = None
+    with contextlib.suppress(OSError):
+        with open(os.path.join(directory, _LATEST)) as f:
+            latest = f.read().strip() or None
+    entries = os.listdir(directory)
+    names = sorted((n for n in entries if n.startswith("ckpt-")),
+                   reverse=True)
+    if latest and latest in names:
+        candidates.append(latest)
+    candidates.extend(n for n in names if n != latest)
+    # aside dirs (a crash mid same-cursor re-commit): last-resort fallback
+    candidates.extend(sorted(
+        (n for n in entries if n.startswith(".old-ckpt-")), reverse=True))
+    fell_back = False
+    for name in candidates:
+        path = os.path.join(directory, name)
+        try:
+            loaded = _load_one(path)
+        except (CheckpointCorrupt, OSError, ValueError) as e:
+            _tm.counter("checkpoint.corrupt").inc()
+            log.warning("checkpoint %s is corrupt (%s); falling back to "
+                        "the previous valid checkpoint", path, e)
+            fell_back = True
+            continue
+        if fell_back:
+            _tm.counter("checkpoint.fallback").inc()
+        _tm.counter("checkpoint.load").inc()
+        env_now = _env_fingerprint()
+        if loaded.manifest.get("env") not in (None, env_now):
+            log.warning(
+                "checkpoint %s was written under a different environment "
+                "(jax/backend/framework changed); resuming anyway — "
+                "numerics may drift", path)
+        return loaded
+    return None
+
+
+def _load_one(path):
+    from .model import _split_param_dict
+    from .ndarray import load as nd_load
+
+    with _tm.span("checkpoint.load_verify"):
+        mpath = os.path.join(path, _MANIFEST)
+        if not os.path.exists(mpath):
+            raise CheckpointCorrupt("missing manifest (incomplete commit)")
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise CheckpointCorrupt(f"unreadable manifest: {e}") from e
+        if manifest.get("format") != _FORMAT:
+            raise CheckpointCorrupt(
+                f"unknown manifest format {manifest.get('format')!r}")
+        for key in ("next_epoch", "next_batch", "files"):
+            if key not in manifest:
+                raise CheckpointCorrupt(f"manifest missing {key!r}")
+        for fname, meta in manifest["files"].items():
+            fpath = os.path.join(path, fname)
+            if not os.path.exists(fpath):
+                raise CheckpointCorrupt(f"missing file {fname}")
+            if os.path.getsize(fpath) != meta["bytes"]:
+                raise CheckpointCorrupt(
+                    f"{fname}: size {os.path.getsize(fpath)} != manifest "
+                    f"{meta['bytes']} (truncated write?)")
+            if sha256_file(fpath) != meta["sha256"]:
+                raise CheckpointCorrupt(f"{fname}: sha256 mismatch")
+        if "params" not in manifest["files"]:
+            raise CheckpointCorrupt("manifest lists no params file")
+        save_dict = nd_load(os.path.join(path, "params"))
+        arg_params, aux_params = _split_param_dict(
+            save_dict, os.path.join(path, "params"))
+        spath = os.path.join(path, "optimizer.states")
+        opt_states = spath if "optimizer.states" in manifest["files"] else None
+        return LoadedCheckpoint(path, manifest, arg_params, aux_params,
+                                opt_states)

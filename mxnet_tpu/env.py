@@ -124,6 +124,75 @@ _declare("MXNET_TRAIN_WINDOW", str, "",
          "dispatch-bound (tunneled) runtimes, K=1 when device/data-bound. "
          "Windows move lr-schedule and metric updates to window "
          "granularity. Empty (default) keeps the per-batch loop.")
+_declare("MXNET_NONFINITE_GUARD", str, "",
+         "Non-finite-gradient sentinel for training updates: 'skip' folds "
+         "a device-side all-finite reduction into the fused train step and "
+         "suppresses the whole parameter/optimizer-state/BN-stat update "
+         "(lax-select, no per-batch host sync) when any gradient is "
+         "NaN/Inf; 'rollback' additionally restores the last checkpoint "
+         "after MXNET_NONFINITE_TOLERANCE consecutive skips (then raises "
+         "if it happens again); 'raise' fails the fit loop on the first "
+         "skipped batch (per-batch host check — debug mode). Empty "
+         "(default) = off. Skips are counted in fit.nonfinite_skip; "
+         "escalation checks run at epoch boundaries.")
+_declare("MXNET_NONFINITE_TOLERANCE", int, 3,
+         "Consecutive non-finite-gradient skips tolerated before "
+         "MXNET_NONFINITE_GUARD=rollback escalates (restore last "
+         "checkpoint, then raise).")
+_declare("MXNET_CHECKPOINT_DIR", str, "",
+         "When set, Module.fit checkpoints to this directory (crash-"
+         "consistent manifested commits, mxnet_tpu.checkpoint) and "
+         "auto-resumes from the latest valid checkpoint at fit start — "
+         "launch.py --max-restarts relaunches continue mid-training. "
+         "Equivalent to fit(checkpoint=CheckpointConfig(dir)).")
+_declare("MXNET_CHECKPOINT_PERIOD", int, 1,
+         "Epochs between checkpoints (MXNET_CHECKPOINT_DIR).")
+_declare("MXNET_CHECKPOINT_KEEP", int, 3,
+         "Checkpoints retained (newest first); 0 keeps everything.")
+_declare("MXNET_CHECKPOINT_BATCH_PERIOD", int, 0,
+         "Additionally checkpoint every N batches mid-epoch (0 = epoch "
+         "boundaries only). Mid-epoch checkpoints record the batch cursor "
+         "so resume skips already-trained batches.")
+_declare("MXNET_IO_RETRY", int, 0,
+         "When > 0, Module.fit wraps the training iterator in "
+         "io.RetryingIter: transient data-source failures (IOError/OSError/"
+         "ConnectionError) are retried up to this many times with "
+         "exponential backoff (telemetry io.retry.*) before the exception "
+         "propagates.")
+_declare("MXNET_IO_RETRY_BACKOFF", float, 0.05,
+         "Initial backoff seconds for io.RetryingIter; doubles per "
+         "attempt, capped at 30 s.")
+_declare("MXNET_KV_TIMEOUT", float, 0.0,
+         "Seconds a dist kvstore barrier may block before the process "
+         "logs actionable diagnostics (rank, peers, likely dead-node "
+         "cause) and hard-exits so a supervisor can restart the job — a "
+         "stalled collective means a dead peer, and the jax runtime "
+         "cannot re-admit single ranks. 0 (default) = wait forever; "
+         "tools/launch.py exports 600 for supervised jobs unless already "
+         "set.")
+_declare("MXNET_FI_CRASH_AT_BATCH", int, -1,
+         "Fault injection: os._exit when the process-global train-batch "
+         "ordinal reaches this value (-1 = off). All MXNET_FI_* hooks "
+         "apply only on the launcher attempt MXNET_FI_ATTEMPT.")
+_declare("MXNET_FI_NAN_BATCHES", str, "",
+         "Fault injection: comma-separated train-batch ordinals whose "
+         "input data is replaced by NaN (drives a non-finite gradient "
+         "through the fused step).")
+_declare("MXNET_FI_ITER_RAISE_BATCHES", str, "",
+         "Fault injection: batch ordinals at which faultinject.FlakyIter "
+         "raises a transient IOError once (retry succeeds).")
+_declare("MXNET_FI_CORRUPT_CKPT", str, "",
+         "Fault injection: 'truncate' or 'garbage' — damage each "
+         "checkpoint's params file right after commit, forcing digest "
+         "verification to fall back to the previous valid checkpoint.")
+_declare("MXNET_FI_ATTEMPT", int, 0,
+         "Launcher attempt (MXNET_NUM_RESTARTS value) the MXNET_FI_* "
+         "injections apply to; -1 = every attempt.")
+_declare("MXNET_FI_RANK", int, -1,
+         "Rank (MXNET_PROC_ID) the MXNET_FI_* injections apply to; "
+         "-1 = every rank.")
+_declare("MXNET_FI_EXIT_CODE", int, 17,
+         "Exit code of the injected crash (MXNET_FI_CRASH_AT_BATCH).")
 _declare("MXNET_XLA_TPU_OPTIONS", str, "",
          "Comma-separated key=value XLA compiler options attached to every "
          "executor program when the target is a TPU (ignored on CPU). The "

@@ -319,6 +319,7 @@ class Executor:
         self._fused_plan = {}  # (names, token, hg, treedef) -> (fn, idxs)
         self._sig_cache = None  # memoized _jit_signature
         self._sym_sha_cache = None  # memoized symbol-graph digest
+        self._guard_dev = None  # device [total, consec] non-finite counters
         if shared_exec is not None:
             # bucketing: share compiled-function cache and memory with the
             # master executor (reference shared_exec data_pool_ reuse,
@@ -671,15 +672,15 @@ class Executor:
         the same non-persistable conditions as :meth:`_aot_digest`. The
         fused program's trace is determined by the graph + argument
         signature plus the plan key (update set, optimizer token, state
-        tree structure, window depth, data-stack names) — state-leaf
-        shapes follow the parameter signature, and hyperparameters are
-        traced inputs."""
+        tree structure, window depth, data-stack names, guard flag) —
+        state-leaf shapes follow the parameter signature, and
+        hyperparameters are traced inputs."""
         if not _aot.cache_enabled():
             return None
         if self._in_shardings or self._node2dev or self._naive:
             return None
         (update_names, cache_token, with_hg, state_td, has_handles,
-         sched_mesh, n_steps, stack_names) = plan_key
+         sched_mesh, n_steps, stack_names, guard_on) = plan_key
         if sched_mesh is not None:
             return None
         opts = _tpu_compiler_options(self._ctx)
@@ -687,10 +688,54 @@ class Executor:
         return _aot.digest(
             "fused", self._sym_sha(), self._jit_signature(),
             (update_names, cache_token, with_hg, repr(state_td),
-             has_handles, n_steps, stack_names),
+             has_handles, n_steps, stack_names, guard_on),
             auto_layout, self.graph.remat, dev.platform,
             getattr(dev, "device_kind", ""),
             tuple(sorted(opts.items())) if opts else (),
+        )
+
+    # --- non-finite-gradient guard (MXNET_NONFINITE_GUARD) -------------
+    @staticmethod
+    def _nonfinite_guard_on():
+        from . import env as _env
+
+        return str(_env.get("MXNET_NONFINITE_GUARD") or "").lower() in (
+            "skip", "rollback", "raise")
+
+    def _guard_zeros(self):
+        # uncommitted (no target device/sharding), like the hyper tape:
+        # jit replicates it to wherever the program runs, so the same
+        # buffer convention works single-device, context-mesh and
+        # named-mesh alike (a committed device-0 scalar would conflict
+        # with mesh-sharded parameters at lowering)
+        import jax
+
+        return jax.device_put(np.zeros(2, np.int32))
+
+    def nonfinite_guard_stats(self):
+        """``(total_skips, consecutive_skips)`` of the fused-step guard.
+
+        Blocks on the device counter buffer — call at sync points (epoch
+        boundaries), never per batch."""
+        g = self._guard_dev
+        if g is None:
+            return (0, 0)
+        import jax
+
+        a = np.asarray(jax.device_get(g))
+        return (int(a[0]), int(a[1]))
+
+    def reset_nonfinite_guard(self, keep_total=True):
+        """Zero the consecutive-skip counter (after a rollback escalation
+        recovered) — or both counters with ``keep_total=False``."""
+        if self._guard_dev is None:
+            return
+        total = self.nonfinite_guard_stats()[0] if keep_total else 0
+        import jax
+
+        self._guard_dev = jax.device_put(
+            np.asarray([total, 0], np.int32),
+            self._guard_dev.sharding,
         )
 
     def _get_jit(self, kind, is_train=False, with_head_grads=False):
@@ -1272,9 +1317,16 @@ class Executor:
         small = self._small_state()
         arg_pack = small["arg"] if small else None
         aux_pack = small["aux"] if small else None
+        # non-finite sentinel (MXNET_NONFINITE_GUARD): when on, the program
+        # all-reduces isfinite over every gradient and lax-selects the OLD
+        # params/opt-state/aux on a non-finite step — the skip happens
+        # entirely on device; the [total, consecutive] skip counters ride a
+        # tiny donated int32 buffer read back only at sync points (epoch
+        # boundaries), so the guard adds zero per-batch host syncs
+        guard_on = self._nonfinite_guard_on()
         plan_key = (tuple(update_names), cache_token, with_hg, state_td,
                     state_handles is not None, sched_mesh, n_steps,
-                    stack_names)
+                    stack_names, guard_on)
         plan = self._fused_plan.get(plan_key)
         if plan is not None:
             _tm.counter("executor.fused_plan_hit").inc()
@@ -1316,7 +1368,8 @@ class Executor:
             ) if st_pack else ()
 
             def _step(upd_vals, arg_flat, other_vals, aux_vals, aux_flat,
-                      rng, heads, prev_grads, st_leaves, st_flat, hyper):
+                      rng, heads, prev_grads, st_leaves, st_flat, hyper,
+                      guard):
                 import jax.numpy as jnp
 
                 full = [None] * n_args
@@ -1342,6 +1395,44 @@ class Executor:
                     )
                     new_params.append(w)
                     new_states.append(s)
+                new_guard = guard
+                if guard_on:
+                    # one scalar reduction per gradient, fused into the
+                    # backward epilogue: any NaN/Inf element propagates to
+                    # the sum (Inf-Inf=NaN included), so isfinite of the
+                    # summed sums detects every non-finite gradient without
+                    # an elementwise isfinite+all pass per tensor. (A
+                    # finite sum overflowing f32 would skip a good batch —
+                    # harmless and astronomically rare.)
+                    probe = jnp.float32(0)
+                    for nm in update_names:
+                        probe = probe + jnp.sum(
+                            grad_map[nm].astype(jnp.float32))
+                    finite = jnp.isfinite(probe)
+                    # a non-finite step keeps the OLD params, optimizer
+                    # state AND aux (BN running stats already absorbed the
+                    # poisoned batch in forward — roll them back too); the
+                    # rng/step/t counters still advance, keeping the host's
+                    # schedule mirrors coherent without a round trip
+                    new_params = [
+                        jnp.where(finite, w, full[upd_idx[i]])
+                        for i, w in enumerate(new_params)
+                    ]
+                    new_states = [
+                        jax.tree_util.tree_map(
+                            lambda nw, ol: jnp.where(finite, nw, ol), ns, os_
+                        )
+                        for ns, os_ in zip(new_states, sts)
+                    ]
+                    aux_upd = [
+                        jnp.where(finite, a, o)
+                        for a, o in zip(aux_upd, full_aux)
+                    ]
+                    miss = jnp.where(finite, 0, 1).astype(guard.dtype)
+                    new_guard = jnp.stack([
+                        guard[0] + miss,
+                        (guard[1] + miss) * miss,  # consecutive: reset on ok
+                    ])
                 new_leaves = jax.tree_util.tree_flatten(new_states)[0]
                 new_leaves, st_flat_out = _split_out(new_leaves, st_fill)
                 # pack the small updated params / grads back into flats
@@ -1372,7 +1463,7 @@ class Executor:
                 next_hyper = hyper.at[2].add(np.float32(1))
                 return (outs, aux_big, aux_flat_out, grad_map, grad_flat,
                         new_params, arg_flat_out, new_leaves, st_flat_out,
-                        next_hyper, _next_step(rng))
+                        next_hyper, new_guard, _next_step(rng))
 
             if n_steps > 1:
                 # training window: fori_loop n_steps-1 STATE-ONLY
@@ -1390,7 +1481,7 @@ class Executor:
 
                 def _step_k(upd_vals, arg_flat, other_vals, aux_vals,
                             aux_flat, rng, heads, prev_grads, st_leaves,
-                            st_flat, hyper, stacks):
+                            st_flat, hyper, guard, stacks):
                     def sub_data(i, ov):
                         ov = list(ov)
                         for p, s in zip(stack_pos, stacks):
@@ -1406,29 +1497,31 @@ class Executor:
                     # is leaner than the standalone step program
                     def body(i, carry):
                         (upd_c, argf_c, aux_c, auxf_c, rng_c, st_c, stf_c,
-                         hyper_c) = carry
+                         hyper_c, guard_c) = carry
                         (_outs, aux_big, aux_flat_out, _gm, _gf,
                          new_params, arg_flat_out, new_leaves, st_flat_out,
-                         next_hyper, next_step) = _step(
+                         next_hyper, new_guard, next_step) = _step(
                             upd_c, argf_c, sub_data(i, other_vals), aux_c,
                             auxf_c, rng_c, heads, prev_grads, st_c, stf_c,
-                            hyper_c,
+                            hyper_c, guard_c,
                         )
                         return (new_params, arg_flat_out, aux_big,
                                 aux_flat_out, (rng_c[0], next_step),
-                                new_leaves, st_flat_out, next_hyper)
+                                new_leaves, st_flat_out, next_hyper,
+                                new_guard)
 
                     init = (upd_vals, arg_flat, aux_vals, aux_flat, rng,
-                            st_leaves, st_flat, hyper)
+                            st_leaves, st_flat, hyper, guard)
                     (upd_f, argf_f, aux_f, auxf_f, rng_f, st_f, stf_f,
-                     hyper_f) = _lax.fori_loop(0, n_steps - 1, body, init)
+                     hyper_f, guard_f) = _lax.fori_loop(
+                        0, n_steps - 1, body, init)
                     # final step, unrolled: full output contract
                     return _step(
                         upd_f, argf_f,
                         sub_data(jnp.asarray(n_steps - 1, jnp.int32),
                                  other_vals),
                         aux_f, auxf_f, rng_f, heads, prev_grads, st_f,
-                        stf_f, hyper_f,
+                        stf_f, hyper_f, guard_f,
                     )
 
                 from . import env as _env
@@ -1462,14 +1555,14 @@ class Executor:
                     except Exception:
                         pass  # layout API unavailable: default layouts
                 jit_fn = jax.jit(
-                    _step_k, donate_argnums=(0, 1, 3, 4, 8, 9, 10),
+                    _step_k, donate_argnums=(0, 1, 3, 4, 8, 9, 10, 11),
                     compiler_options=_tpu_compiler_options(self._ctx),
                     **jit_kw,
                 )
             else:
                 plan_auto = False
                 jit_fn = jax.jit(
-                    _step, donate_argnums=(0, 1, 3, 4, 8, 9, 10),
+                    _step, donate_argnums=(0, 1, 3, 4, 8, 9, 10, 11),
                     compiler_options=_tpu_compiler_options(self._ctx),
                 )
             plan = (
@@ -1519,10 +1612,18 @@ class Executor:
             hyper = jax.device_put(hyper_host)
         self._hyper_dev_cache = None  # donated below; never reuse on failure
 
+        # guard counters live on device across steps (donated in, new value
+        # out); a fresh zeros buffer only on the first guarded step or after
+        # a rollback reset. The same (dead) buffer rides along un-guarded
+        # programs so the calling convention stays uniform.
+        guard_in = getattr(self, "_guard_dev", None)
+        if guard_in is None:
+            guard_in = self._guard_zeros()
+
         call_args = (
             upd_vals, args_flat, other_vals, self._bwd_aux, aux_flat,
             self._bwd_rng, head_grads, self._bwd_prev, state_leaves,
-            st_flat, hyper,
+            st_flat, hyper, guard_in,
         )
         if n_steps > 1:
             call_args += (stack_vals,)
@@ -1578,7 +1679,7 @@ class Executor:
                             aot[1] = None
                             plain = jax.jit(
                                 fn.__wrapped__,
-                                donate_argnums=(0, 1, 3, 4, 8, 9, 10),
+                                donate_argnums=(0, 1, 3, 4, 8, 9, 10, 11),
                                 compiler_options=_tpu_compiler_options(
                                     self._ctx
                                 ),
@@ -1605,7 +1706,7 @@ class Executor:
                 dispatched = True
                 (outs, aux_upd, aux_flat_out, grad_map, grad_flat,
                  new_params, arg_flat_out, new_leaves, st_flat_out,
-                 next_hyper, next_step) = aot[0](*call_args)
+                 next_hyper, new_guard, next_step) = aot[0](*call_args)
         except Exception:
             # a failure AFTER dispatch leaves the donated pack flats
             # consumed: invalidate so packed reads fail LOUDLY (the thunks
@@ -1619,7 +1720,10 @@ class Executor:
                         p["flat"] = None
                 if st_pack is not None:
                     st_pack["flat"] = None
+            if dispatched:
+                self._guard_dev = None  # donated; counters restart at zero
             raise
+        self._guard_dev = new_guard
         self._accept_next_step(
             next_step,
             getattr(self, "_bwd_rng_val", self._step) + (n_steps - 1),

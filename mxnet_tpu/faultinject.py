@@ -1,0 +1,197 @@
+"""Deterministic, env-driven fault injection for the robustness tests.
+
+Every fault the fault-tolerance subsystem claims to survive is injectable
+here, scriptable from the environment so subprocess tests can arrange a
+fault without patching framework code:
+
+==============================  =============================================
+``MXNET_FI_CRASH_AT_BATCH``     ``os._exit`` (no cleanup, like a kill -9)
+                                when the process-global train-batch ordinal
+                                reaches this value (0-based; -1 = off).
+``MXNET_FI_NAN_BATCHES``        comma-separated batch ordinals whose input
+                                data is replaced by NaN — the natural way to
+                                produce a non-finite gradient inside the
+                                fused train step.
+``MXNET_FI_ITER_RAISE_BATCHES`` batch ordinals at which :class:`FlakyIter`
+                                raises a transient ``IOError`` ONCE (the
+                                retry then succeeds) — exercises
+                                ``io.RetryingIter``.
+``MXNET_FI_CORRUPT_CKPT``       ``truncate`` or ``garbage``: damage the
+                                params file of every checkpoint right after
+                                it commits — exercises digest verification
+                                and previous-checkpoint fallback.
+``MXNET_FI_ATTEMPT``            which launcher attempt the injections apply
+                                to (compared against ``MXNET_NUM_RESTARTS``;
+                                default 0 = first life only, so a restarted
+                                job trains clean).
+``MXNET_FI_EXIT_CODE``          exit code for the injected crash
+                                (default 17).
+==============================  =============================================
+
+All hooks are no-ops (one cheap env check) when nothing is configured;
+``Module.fit`` disables train-window fusion while injection is active so
+batch ordinals stay exact.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from . import telemetry as _tm
+from .base import MXNetError
+from .io import DataIter
+
+_lock = threading.Lock()
+_batch_ordinal = -1  # process-global count of train batches seen by fit
+
+
+def _csv_ints(name):
+    raw = os.environ.get(name, "")
+    out = set()
+    for part in raw.split(","):
+        part = part.strip()
+        if part:
+            try:
+                out.add(int(part))
+            except ValueError:
+                raise MXNetError(f"{name}: {part!r} is not an integer")
+    return out
+
+
+def _attempt_matches():
+    want = int(os.environ.get("MXNET_FI_ATTEMPT", "0") or 0)
+    if want < 0:
+        return True  # -1: every attempt
+    return int(os.environ.get("MXNET_NUM_RESTARTS", "0") or 0) == want
+
+
+def _rank_matches():
+    want = int(os.environ.get("MXNET_FI_RANK", "-1") or -1)
+    if want < 0:
+        return True  # any rank
+    return int(os.environ.get("MXNET_PROC_ID", "0") or 0) == want
+
+
+def active():
+    """True when any fault is configured for THIS launcher attempt+rank."""
+    if not any(os.environ.get(k) for k in (
+            "MXNET_FI_CRASH_AT_BATCH", "MXNET_FI_NAN_BATCHES",
+            "MXNET_FI_ITER_RAISE_BATCHES", "MXNET_FI_CORRUPT_CKPT")):
+        return False
+    return _attempt_matches() and _rank_matches()
+
+
+def reset():
+    """Rewind the process-global batch ordinal (tests only)."""
+    global _batch_ordinal
+    with _lock:
+        _batch_ordinal = -1
+
+
+def on_train_batch(data_batch):
+    """Per-batch hook in ``Module.fit``: advances the global batch ordinal
+    and fires any crash/NaN injection scheduled for it. Returns the
+    (possibly corrupted) batch."""
+    global _batch_ordinal
+    if not active():
+        return data_batch
+    with _lock:
+        _batch_ordinal += 1
+        ordinal = _batch_ordinal
+    crash_at = int(os.environ.get("MXNET_FI_CRASH_AT_BATCH", "-1") or -1)
+    if crash_at >= 0 and ordinal == crash_at:
+        # a real machine death: no atexit, no flushes beyond this print
+        print(f"faultinject: CRASH at train batch {ordinal}", flush=True)
+        os._exit(int(os.environ.get("MXNET_FI_EXIT_CODE", "17")))
+    if ordinal in _csv_ints("MXNET_FI_NAN_BATCHES"):
+        _tm.counter("faultinject.nan_batch").inc()
+        _poison_batch(data_batch)
+    return data_batch
+
+
+def _poison_batch(data_batch):
+    """Replace every float data array of the batch with NaNs (labels stay —
+    integer label encodings have no NaN). Shape/dtype metadata only: no
+    device read, so injection itself never perturbs the sync counters the
+    guard tests assert on."""
+    import numpy as np
+
+    from .ndarray import array
+
+    poisoned = []
+    for arr in data_batch.data or []:
+        dtype = np.dtype(getattr(arr, "dtype", np.float32))
+        if np.issubdtype(dtype, np.floating):
+            poisoned.append(
+                array(np.full(tuple(arr.shape), np.nan, dtype)))
+        else:
+            poisoned.append(arr)
+    data_batch.data = poisoned
+    data_batch.staged = False  # re-stage: the arrays are new
+    return data_batch
+
+
+def post_checkpoint_commit(params_path):
+    """Called by CheckpointManager right after a checkpoint commits:
+    optionally damages the just-written params file (simulating later disk
+    corruption / a torn replica) so the NEXT load must fall back."""
+    mode = os.environ.get("MXNET_FI_CORRUPT_CKPT", "")
+    if not mode or not _attempt_matches() or not _rank_matches():
+        return
+    corrupt_file(params_path, mode)
+    _tm.counter("faultinject.corrupt_ckpt").inc()
+
+
+def corrupt_file(path, mode="truncate"):
+    """Damage ``path`` in place: ``truncate`` keeps the first half,
+    ``garbage`` flips bytes in the middle. Direct test helper."""
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "rb+") as f:
+            f.truncate(max(1, size // 2))
+    elif mode == "garbage":
+        with open(path, "rb+") as f:
+            f.seek(size // 2)
+            f.write(b"\xde\xad\xbe\xef" * 8)
+    else:
+        raise MXNetError(f"corrupt_file: unknown mode {mode!r}")
+    return path
+
+
+class FlakyIter(DataIter):
+    """Wraps a DataIter; raises a transient ``IOError`` the first time each
+    configured batch ordinal (per epoch position) is requested. A retry of
+    the same ``next()`` succeeds and yields the batch that would have been
+    returned — the contract ``io.RetryingIter`` restores."""
+
+    def __init__(self, data_iter, raise_at=None):
+        super().__init__(getattr(data_iter, "batch_size", 0))
+        self._iter = data_iter
+        self._raise_at = (set(raise_at) if raise_at is not None
+                          else _csv_ints("MXNET_FI_ITER_RAISE_BATCHES"))
+        self._pos = -1
+        self._raised = set()
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._pos = -1
+        self._raised.clear()
+        self._iter.reset()
+
+    def next(self):
+        nxt = self._pos + 1
+        if nxt in self._raise_at and nxt not in self._raised:
+            self._raised.add(nxt)
+            _tm.counter("faultinject.iter_raise").inc()
+            raise IOError(f"faultinject: transient read error at batch {nxt}")
+        batch = self._iter.next()  # raises StopIteration at the end
+        self._pos = nxt
+        return batch

@@ -15,6 +15,7 @@ the C++ data plane; this module is the framework-level iterator API.
 from __future__ import annotations
 
 import gzip
+import logging
 import os
 import queue as _queue
 import struct
@@ -268,6 +269,94 @@ class ResizeIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+class RetryingIter(DataIter):
+    """Retry transient data-source failures with exponential backoff.
+
+    Wraps any DataIter whose ``next()``/``reset()`` may raise transient
+    errors (flaky network mounts, object stores, remote record services) and
+    retries up to ``max_retries`` times per call, sleeping
+    ``backoff * 2**attempt`` seconds (capped at ``max_backoff``) between
+    attempts. The wrapped iterator's retry contract is its own: a
+    well-behaved source re-serves the batch that failed (see
+    ``faultinject.FlakyIter`` for the test double).
+
+    Telemetry: ``io.retry.attempts`` counts every retried call,
+    ``io.retry.giveup`` exhausted budgets, ``io.retry.backoff_us`` the time
+    slept. ``Module.fit`` wraps the training iterator automatically when
+    ``MXNET_IO_RETRY > 0``.
+    """
+
+    #: exception types considered transient (StopIteration never retries)
+    TRANSIENT = (IOError, OSError, ConnectionError, TimeoutError)
+
+    def __init__(self, data_iter, max_retries=3, backoff=0.05,
+                 max_backoff=30.0, retry_on=None, logger=None):
+        super().__init__(getattr(data_iter, "batch_size", 0))
+        self._iter = data_iter
+        self._max_retries = max(1, int(max_retries))
+        self._backoff = float(backoff)
+        self._max_backoff = float(max_backoff)
+        self._retry_on = tuple(retry_on) if retry_on else self.TRANSIENT
+        self._logger = logger or logging.getLogger("mxnet_tpu.io")
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def _call(self, what, fn):
+        for attempt in range(self._max_retries + 1):
+            try:
+                return fn()
+            except StopIteration:
+                raise
+            except self._retry_on as e:
+                if attempt >= self._max_retries:
+                    _telemetry.counter("io.retry.giveup").inc()
+                    self._logger.error(
+                        "data source %s failed after %d retries: %s",
+                        what, self._max_retries, e)
+                    raise
+                delay = min(self._backoff * (2 ** attempt),
+                            self._max_backoff)
+                _telemetry.counter("io.retry.attempts").inc()
+                _telemetry.histogram("io.retry.backoff_us").observe(
+                    int(delay * 1e6))
+                self._logger.warning(
+                    "data source %s failed (%s); retry %d/%d in %.2fs",
+                    what, e, attempt + 1, self._max_retries, delay)
+                _time.sleep(delay)
+
+    def reset(self):
+        self._call("reset", self._iter.reset)
+
+    def next(self):
+        return self._call("next", self._iter.next)
+
+    def iter_next(self):
+        return self._call("iter_next", self._iter.iter_next)
+
+    def getdata(self):
+        return self._iter.getdata()
+
+    def getlabel(self):
+        return self._iter.getlabel()
+
+    def getindex(self):
+        return self._iter.getindex()
+
+    def getpad(self):
+        return self._iter.getpad()
+
+    def close(self):
+        close = getattr(self._iter, "close", None)
+        if close:
+            close()
 
 
 class PrefetchingIter(DataIter):

@@ -28,10 +28,70 @@ set_optimizer/rank/num_workers/barrier) as the coordination surface:
 from __future__ import annotations
 
 import pickle
+import threading
 
 from .base import MXNetError
 from .ndarray import NDArray, zeros
 from . import telemetry as _tm
+
+
+class _CollectiveWatchdog:
+    """Actionable diagnostics for a blocked cross-host collective.
+
+    A jax collective cannot be interrupted from Python once dispatched, so
+    an indefinitely blocked barrier (the signature of a dead peer: the
+    survivors sit inside the all-reduce forever) used to hang the job
+    silently. With ``MXNET_KV_TIMEOUT > 0`` a watchdog thread logs WHO is
+    stuck and WHY it is unrecoverable, then hard-exits the process — under
+    ``tools/launch.py --max-restarts`` (which exports the timeout by
+    default) that converts a silent hang into a supervised whole-job
+    restart, and with checkpointing configured the relaunch resumes
+    mid-training.
+    """
+
+    def __init__(self, what, rank, num_workers, timeout):
+        self._done = threading.Event()
+        self._timeout = timeout
+        if timeout and timeout > 0:
+            t = threading.Thread(
+                target=self._watch, args=(what, rank, num_workers),
+                daemon=True, name=f"kv-watchdog-{what}")
+            t.start()
+
+    def _watch(self, what, rank, num_workers):
+        import logging
+        import os
+        import sys
+
+        if self._done.wait(self._timeout):
+            return
+        _tm.counter("kvstore.collective_timeout").inc()
+        msg = (
+            f"kvstore: rank {rank}/{num_workers} blocked in '{what}' for "
+            f"{self._timeout:.0f}s (MXNET_KV_TIMEOUT). A stalled "
+            "collective almost always means a peer process died "
+            "mid-step; the jax runtime cannot re-admit a single rank, so "
+            "this process exits now to let the supervisor restart the "
+            "whole job (tools/launch.py --max-restarts). With "
+            "MXNET_CHECKPOINT_DIR set the relaunch resumes from the last "
+            "checkpoint. To wait forever instead, set MXNET_KV_TIMEOUT=0."
+        )
+        logging.getLogger("mxnet_tpu.kvstore").critical(msg)
+        print(msg, file=sys.stderr, flush=True)
+        os._exit(41)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._done.set()
+        return False
+
+
+def _kv_timeout():
+    from . import env as _env
+
+    return float(_env.get("MXNET_KV_TIMEOUT") or 0.0)
 
 
 def _key_str(key):
@@ -367,7 +427,9 @@ class DistKVStore(KVStore):
         if self.num_workers > 1:
             from .ndarray import NDArray as _ND
 
-            with _tm.span("kvstore.barrier_wait"):
+            with _tm.span("kvstore.barrier_wait"), \
+                    _CollectiveWatchdog("barrier", self.rank,
+                                        self.num_workers, _kv_timeout()):
                 jax.block_until_ready(self._allreduce(_ND(jnp.ones((1,)))))
 
 

@@ -85,28 +85,52 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """Save symbol JSON + params (reference model.py save_checkpoint)."""
+    """Save symbol JSON + params (reference model.py save_checkpoint).
+
+    Both files commit atomically (write-to-temp + fsync + rename, see
+    :mod:`mxnet_tpu.checkpoint`): a crash mid-save can never leave a torn
+    ``.params`` file for the next load to trip over.
+    """
+    from .checkpoint import atomic_path
+
     if symbol is not None:
-        symbol.save(f"{prefix}-symbol.json")
+        with atomic_path(f"{prefix}-symbol.json") as tmp:
+            symbol.save(tmp)
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
     param_name = f"{prefix}-{epoch:04d}.params"
-    nd_save(param_name, save_dict)
+    with atomic_path(param_name) as tmp:
+        nd_save(tmp, save_dict)
     logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def _split_param_dict(save_dict, source):
+    """Split a loaded ``{prefix:name → NDArray}`` dict into (arg, aux).
+
+    A key whose prefix is neither ``arg:`` nor ``aux:`` raises — silently
+    dropping it would lose parameters (the historical behavior) and turn a
+    corrupt/mis-written file into a quietly wrong model."""
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if not _ or tp not in ("arg", "aux"):
+            raise ValueError(
+                f"{source}: invalid parameter key {k!r} — expected an "
+                "'arg:<name>' or 'aux:<name>' prefix. The file is not a "
+                "checkpoint params file (or is corrupt); refusing to "
+                "silently drop parameters."
+            )
+        (arg_params if tp == "arg" else aux_params)[name] = v
+    return arg_params, aux_params
 
 
 def load_checkpoint(prefix, epoch):
     """Load (symbol, arg_params, aux_params) (reference load_checkpoint)."""
     symbol = sym_mod.load(f"{prefix}-symbol.json")
-    save_dict = nd_load(f"{prefix}-{epoch:04d}.params")
-    arg_params = {}
-    aux_params = {}
-    for k, v in save_dict.items():
-        tp, name = k.split(":", 1)
-        if tp == "arg":
-            arg_params[name] = v
-        if tp == "aux":
-            aux_params[name] = v
+    param_name = f"{prefix}-{epoch:04d}.params"
+    save_dict = nd_load(param_name)
+    arg_params, aux_params = _split_param_dict(save_dict, param_name)
     return (symbol, arg_params, aux_params)
 
 

@@ -38,3 +38,23 @@ def next_key():
     key = _get_key()
     _state.key, sub = jax.random.split(key)
     return sub
+
+
+def get_state():
+    """The raw key data of the global generator as a list of ints —
+    JSON-serializable for checkpoint manifests."""
+    import jax
+    import numpy as np
+
+    key = _get_key()
+    data = jax.random.key_data(key) if hasattr(jax.random, "key_data") \
+        else key
+    return [int(x) for x in np.asarray(data).ravel()]
+
+
+def set_state(state):
+    """Restore a key captured by :func:`get_state` (checkpoint resume)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    _state.key = jnp.asarray(np.asarray(state, dtype=np.uint32))

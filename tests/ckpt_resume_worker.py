@@ -1,0 +1,68 @@
+"""Worker for the single-host kill-resume test (tests/test_checkpoint.py).
+
+Trains a small deterministic MLP with env-driven checkpointing
+(MXNET_CHECKPOINT_DIR + MXNET_CHECKPOINT_BATCH_PERIOD) so `Module.fit`
+saves crash-consistent checkpoints mid-epoch. The test's first launch sets
+MXNET_FI_CRASH_AT_BATCH so faultinject hard-kills the process (os._exit,
+no cleanup) mid-epoch; the second launch sets MXNET_NUM_RESTARTS=1 (the
+launcher convention) so the injection is disarmed, and fit must auto-resume
+from the last committed checkpoint.
+
+Prints machine-checkable lines:
+  RESUME epoch=<E> batch=<B> num_update=<N>   (pre-fit view of the latest
+                                               checkpoint; epoch=-1 if none)
+  TRAIN-DONE acc=<float> final_update=<N>
+"""
+
+import logging
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, stream=sys.stdout)
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(42)
+    X = rng.randn(64, 10).astype(np.float32)
+    W = rng.randn(10, 4).astype(np.float32)
+    Y = X.dot(W).argmax(1).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=16, name="fc1"),
+        act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=4, name="fc2"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, Y, batch_size=8)  # 8 batches/epoch
+
+    ckpt_dir = os.environ["MXNET_CHECKPOINT_DIR"]
+    loaded = mx.checkpoint.load_latest(ckpt_dir)
+    if loaded is None:
+        print("RESUME epoch=-1 batch=-1 num_update=0", flush=True)
+    else:
+        meta = loaded.manifest.get("optimizer") or {}
+        print(f"RESUME epoch={loaded.next_epoch} batch={loaded.next_batch} "
+              f"num_update={meta.get('num_update', 0)}", flush=True)
+
+    mx.random.seed(7)
+    mod.fit(
+        it, num_epoch=int(os.environ.get("WORKER_NUM_EPOCH", "6")),
+        initializer=mx.init.Xavier(),
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+    )
+    metric = mx.metric.Accuracy()
+    acc = mod.score(it, metric)[0][1]
+    final_update = mod._optimizer.num_update
+    print(f"TRAIN-DONE acc={acc:.3f} final_update={final_update}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

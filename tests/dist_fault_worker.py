@@ -3,13 +3,19 @@
 Reference analogue: ps-lite's scheduler notices a dead node
 (``src/kvstore/kvstore_dist.h:177-185``) and restarted servers rejoin via
 ``is_recovery``. Here recovery is the launcher's whole-job restart
-(tools/launch.py --max-restarts): on the FIRST attempt rank 1 hard-crashes
-mid-epoch (os._exit — no cleanup, like a real kill), the supervisor tears
-the job down and relaunches all ranks, and the second attempt must train to
-convergence with ``kv.num_dead_node`` reporting the recovery.
+(tools/launch.py --max-restarts) PLUS checkpoint auto-resume: on the FIRST
+attempt rank 1 hard-crashes mid-epoch (faultinject os._exit — no cleanup,
+like a real kill; MXNET_FI_CRASH_AT_BATCH/MXNET_FI_RANK set by the test),
+the supervisor tears the job down and relaunches all ranks, and the second
+attempt must RESUME from the checkpointed epoch (not epoch 0) — rank 0
+writes barrier-fenced checkpoints to the shared MXNET_CHECKPOINT_DIR —
+then train to convergence with ``kv.num_dead_node`` reporting the
+recovery.
 """
 
+import logging
 import os
+import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -17,6 +23,7 @@ import numpy as np
 
 
 def main():
+    logging.basicConfig(level=logging.INFO, stream=sys.stdout)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -30,7 +37,7 @@ def main():
     X = rng.randn(128, 10).astype(np.float32)
     W = rng.randn(10, 4).astype(np.float32)
     Y = X.dot(W).argmax(1).astype(np.float32)
-    Xs, Ys = X[rank::nw], Y[rank::nw]
+    Xs, Ys = X[rank::nw], Y[rank::nw]  # 64 samples/rank, 4 batches/epoch
 
     data = mx.sym.Variable("data")
     h = mx.sym.Activation(
@@ -40,37 +47,35 @@ def main():
         mx.sym.FullyConnected(h, num_hidden=4, name="fc2"), name="softmax")
     mod = mx.mod.Module(net, context=mx.cpu())
     it = mx.io.NDArrayIter(Xs, Ys, batch_size=16)
-    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+
+    ckpt_dir = os.environ["MXNET_CHECKPOINT_DIR"]
+    loaded = mx.checkpoint.load_latest(ckpt_dir)
+    resume_epoch = loaded.next_epoch if loaded is not None else 0
+    print(f"rank {rank} attempt {attempt} RESUME epoch={resume_epoch}",
+          flush=True)
+    if attempt > 0:
+        # the whole point: the relaunch continues mid-training, not from 0
+        assert loaded is not None and resume_epoch > 0, (
+            f"rank {rank}: post-restart attempt found no checkpoint to "
+            "resume from")
+
     mx.random.seed(7)
-    mod.init_params(initializer=mx.init.Xavier())
-    mod.init_optimizer(
-        kvstore=kv, optimizer="sgd",
+    mod.fit(
+        it, num_epoch=25, kvstore=kv, initializer=mx.init.Xavier(),
+        optimizer="sgd",
         optimizer_params={"learning_rate": 0.2, "rescale_grad": 1.0 / nw},
     )
     metric = mx.metric.Accuracy()
-    step = 0
-    for epoch in range(25):
-        it.reset()
-        metric.reset()
-        for batch in it:
-            mod.forward_backward(batch)
-            mod.update()
-            mod.update_metric(metric, batch.label)
-            step += 1
-            if attempt == 0 and rank == 1 and epoch == 3:
-                # simulate a mid-training machine death: no cleanup, no
-                # barrier — surviving ranks are left inside the job
-                print(f"rank {rank} CRASHING at epoch {epoch}", flush=True)
-                os._exit(17)
-    acc = metric.get()[1]
+    acc = mod.score(it, metric)[0][1]
     assert acc > 0.8, f"rank {rank}: post-recovery training stuck at {acc}"
-    assert kv.num_dead_node == 1, (
-        f"rank {rank}: num_dead_node={kv.num_dead_node}, expected the one "
-        "recovered death"
+    assert kv.num_dead_node == attempt, (
+        f"rank {rank}: num_dead_node={kv.num_dead_node}, expected "
+        f"{attempt} recovered death(s)"
     )
     kv.barrier()
     print(f"rank {rank}/{nw} FAULT-RECOVERY OK acc={acc:.3f} "
-          f"dead={kv.num_dead_node}", flush=True)
+          f"dead={kv.num_dead_node} resumed_from={resume_epoch}",
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -18,6 +18,8 @@ def test_bench_cpu_smoke():
     env["PYTHONPATH"] = os.pathsep.join([_ROOT] + clean)
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_LAYERS"] = "18"
+    env["BENCH_ITERS"] = "3"
+    env["BENCH_WINDOWS"] = "2"
     r = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "bench.py")],
         capture_output=True, text=True, env=env, timeout=900, cwd=_ROOT,
@@ -27,6 +29,9 @@ def test_bench_cpu_smoke():
     rec = json.loads(line)
     assert rec["unit"] == "images/sec" and rec["value"] > 0
     assert "cpusmoke" in rec["metric"]
+    # the non-finite guard's cost stays visible in every BENCH_*.json
+    assert "nonfinite_guard_overhead" in rec
+    assert rec["guard_on_img_per_sec"] > 0
 
 
 def test_bench_fit_mode_reaches_window_rate():
@@ -45,6 +50,9 @@ def test_bench_fit_mode_reaches_window_rate():
     # 3 timed windows/epochs per mode: the reported value is a median, so a
     # single host hiccup in one window can't sink the comparison
     env["BENCH_WINDOWS"] = "3"
+    # the guard-overhead re-measure is test_bench_cpu_smoke's job; here it
+    # would only stretch the train-mode run this comparison waits on
+    env["BENCH_GUARD"] = "0"
 
     def run(mode):
         e = dict(env)
@@ -67,6 +75,36 @@ def test_bench_fit_mode_reaches_window_rate():
     assert fit_rate >= 0.9 * window["value"], (
         f"fit loop at {fit_rate} img/s vs train_window "
         f"{window['value']} img/s — async pipeline regressed")
+
+
+def test_bench_fit_guard_on_keeps_no_sync_invariant():
+    """With MXNET_NONFINITE_GUARD=skip, the fit loop's steady-state
+    telemetry (embedded in the bench record) must show ZERO host-blocking
+    syncs — the guard's skip decision lives on device and never reads
+    back per batch."""
+    env = dict(os.environ)
+    clean = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([_ROOT] + clean)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_LAYERS"] = "18"
+    env["BENCH_BATCH"] = "4"
+    env["BENCH_ITERS"] = "4"
+    env["BENCH_WINDOWS"] = "2"
+    env["BENCH_MODE"] = "fit"
+    env["BENCH_WARM_START"] = "0"
+    env["MXNET_NONFINITE_GUARD"] = "skip"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py")],
+        capture_output=True, text=True, env=env, timeout=900, cwd=_ROOT,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    nd = rec["telemetry"].get("ndarray", {})
+    assert nd.get("asnumpy", 0) == 0, rec["telemetry"]
+    assert nd.get("wait_to_read", 0) == 0, rec["telemetry"]
+    metric = rec["telemetry"].get("metric", {})
+    assert metric.get("numpy_fallback", 0) == 0, rec["telemetry"]
 
 
 def test_graft_entry_single_chip_compiles():

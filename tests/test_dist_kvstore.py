@@ -112,18 +112,25 @@ def test_dist_sync_kvstore_local_processes(nproc):
         assert f"rank {r}/{nproc} DIST OK" in out, out[-4000:]
 
 
-def test_mid_training_worker_kill_recovers_and_converges():
-    """Fault injection at FULL depth: rank 1 hard-dies (os._exit, no
-    cleanup) in the middle of epoch 3 of a real dist_sync training run —
-    the survivors are mid-collective — and the launcher's whole-job
-    restart must bring the job back to convergence, with
-    kv.num_dead_node reporting the recovered death on every rank
-    (reference: ps-lite dead-node detection + is_recovery,
-    src/kvstore/kvstore_dist.h:177-195)."""
+def test_mid_training_worker_kill_recovers_and_converges(tmp_path):
+    """Fault injection at FULL depth: rank 1 hard-dies (faultinject
+    os._exit, no cleanup) in the middle of epoch 3 of a real dist_sync
+    training run — the survivors are mid-collective — and the launcher's
+    whole-job restart must bring the job back, RESUMED from the
+    checkpointed epoch (rank 0 writes barrier-fenced checkpoints to the
+    shared dir; not from epoch 0), to convergence, with kv.num_dead_node
+    reporting the recovered death on every rank (reference: ps-lite
+    dead-node detection + is_recovery, src/kvstore/kvstore_dist.h:177-195)."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXNET_CHECKPOINT_DIR"] = str(tmp_path / "ckpts")
+    # rank 1 dies at global batch 14 = epoch 3, batch 2 (4 batches/epoch),
+    # first attempt only
+    env["MXNET_FI_CRASH_AT_BATCH"] = "14"
+    env["MXNET_FI_RANK"] = "1"
+    env["MXNET_FI_ATTEMPT"] = "0"
     cmd = [
         sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
         "-n", "2", "--launcher", "local", "--port", str(_free_port()),
@@ -134,11 +141,14 @@ def test_mid_training_worker_kill_recovers_and_converges():
                           timeout=600)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, f"fault recovery failed:\n{out[-4000:]}"
-    assert "rank 1 CRASHING at epoch 3" in out, out[-4000:]
+    assert "faultinject: CRASH at train batch 14" in out, out[-4000:]
     assert "whole-job restart 1/2" in out, out[-4000:]
+    # the post-restart attempt resumed from the checkpointed epoch, not 0
+    assert "attempt 1 RESUME epoch=3" in out, out[-4000:]
+    assert "Resuming from checkpoint" in out, out[-4000:]
     for r in range(2):
         assert f"rank {r}/2 FAULT-RECOVERY OK" in out, out[-4000:]
-    assert "dead=1" in out, out[-4000:]
+    assert "dead=1" in out and "resumed_from=3" in out, out[-4000:]
 
 
 def test_async_wire_format_roundtrip():

@@ -1,0 +1,240 @@
+"""Non-finite-gradient guard and transient-IO retry.
+
+The guard folds an all-finite reduction into the fused train step
+(MXNET_NONFINITE_GUARD): a NaN/Inf gradient batch must leave params
+bit-identical, increment fit.nonfinite_skip, and add ZERO host-blocking
+syncs (asserted on the framework's own telemetry counters, like
+tests/test_async_pipeline.py). Escalation: rollback restores the last
+checkpoint after K consecutive skips, then raises; raise fails fast.
+RetryingIter turns transient data-source failures into backoff+retry.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import faultinject as fi
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=8, name="fc1"),
+        act_type="relu")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=4, name="fc2"), name="softmax")
+
+
+def _iter(n=32, batch=8):
+    rng = np.random.RandomState(0)
+    return mx.io.NDArrayIter(
+        rng.randn(n, 10).astype(np.float32),
+        rng.randint(0, 4, (n,)).astype(np.float32), batch_size=batch)
+
+
+def _module(it):
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    return mod
+
+
+_SYNC = ("ndarray.asnumpy", "ndarray.wait_to_read", "metric.numpy_fallback")
+
+
+def test_guard_skip_leaves_params_bit_identical(monkeypatch):
+    """A NaN-gradient step under guard=skip is a no-op for params,
+    optimizer state AND BN-style aux, and counts [total, consecutive]."""
+    monkeypatch.setenv("MXNET_NONFINITE_GUARD", "skip")
+    it = _iter()
+    mod = _module(it)
+    clean = next(iter(it))
+    mod.forward_backward(clean)
+    mod.update()
+    w0 = mod._exec_group._exec.arg_dict["fc1_weight"].asnumpy().copy()
+    mom0 = {k: v for k, v in
+            (mod._updater.states.items() if mod._updater else [])}
+
+    bad = mx.io.DataBatch(
+        data=[mx.nd.array(np.full((8, 10), np.nan, np.float32))],
+        label=clean.label)
+    mod.forward_backward(bad)
+    mod.update()
+    assert mod.nonfinite_stats() == (1, 1)
+    w1 = mod._exec_group._exec.arg_dict["fc1_weight"].asnumpy()
+    np.testing.assert_array_equal(w0, w1)
+    # momentum state also untouched (same handles, same values)
+    for k, s in mom0.items():
+        np.testing.assert_array_equal(
+            np.asarray(s._data if hasattr(s, "_data") else s),
+            np.asarray(mod._updater.states[k]._data
+                       if hasattr(mod._updater.states[k], "_data")
+                       else mod._updater.states[k]))
+
+    # a clean step resets the consecutive counter and trains again
+    mod.forward_backward(clean)
+    mod.update()
+    assert mod.nonfinite_stats() == (1, 0)
+    w2 = mod._exec_group._exec.arg_dict["fc1_weight"].asnumpy()
+    assert not np.array_equal(w1, w2)
+
+
+def test_guard_skip_in_fit_no_extra_syncs(monkeypatch):
+    """fit + injected NaN batch: fit.nonfinite_skip increments, the run
+    completes, and the guard adds zero per-batch asnumpy /
+    wait_to_read / numpy-fallback syncs (telemetry-counter-verified)."""
+    monkeypatch.setenv("MXNET_NONFINITE_GUARD", "skip")
+    monkeypatch.setenv("MXNET_FI_NAN_BATCHES", "2")
+    fi.reset()
+    tm.reset()
+    it = _iter()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=2,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    assert tm.counter("fit.nonfinite_skip").value == 1
+    for name in _SYNC:
+        assert tm.counter(name).value == 0, name
+    assert tm.counter("metric.drain_sync").value == 2  # one per epoch
+    assert tm.counter("fit.batches").value == 8
+    assert mod.nonfinite_stats()[0] == 1
+
+
+def test_guard_off_by_default():
+    it = _iter()
+    mod = _module(it)
+    clean = next(iter(it))
+    mod.forward_backward(clean)
+    mod.update()
+    w0 = mod._exec_group._exec.arg_dict["fc1_weight"].asnumpy().copy()
+    bad = mx.io.DataBatch(
+        data=[mx.nd.array(np.full((8, 10), np.nan, np.float32))],
+        label=clean.label)
+    mod.forward_backward(bad)
+    mod.update()
+    # ungated: NaN propagates into the weights (the historical behavior)
+    w1 = mod._exec_group._exec.arg_dict["fc1_weight"].asnumpy()
+    assert np.isnan(w1).any() and not np.array_equal(w0, w1)
+    assert mod.nonfinite_stats() == (0, 0)
+
+
+def test_guard_imperative_path(monkeypatch):
+    """The guard also covers the un-fused per-param update path (NaiveEngine
+    / bulk-exec off), via a host-side check."""
+    monkeypatch.setenv("MXNET_NONFINITE_GUARD", "skip")
+    monkeypatch.setenv("MXNET_EXEC_BULK_EXEC_TRAIN", "0")
+    it = _iter()
+    mod = _module(it)
+    clean = next(iter(it))
+    mod.forward_backward(clean)
+    mod.update()
+    w0 = mod._exec_group._exec.arg_dict["fc1_weight"].asnumpy().copy()
+    bad = mx.io.DataBatch(
+        data=[mx.nd.array(np.full((8, 10), np.nan, np.float32))],
+        label=clean.label)
+    mod.forward_backward(bad)
+    mod.update()
+    np.testing.assert_array_equal(
+        w0, mod._exec_group._exec.arg_dict["fc1_weight"].asnumpy())
+    assert mod.nonfinite_stats() == (1, 1)
+
+
+def test_guard_raise_fails_fast(monkeypatch):
+    monkeypatch.setenv("MXNET_NONFINITE_GUARD", "raise")
+    monkeypatch.setenv("MXNET_FI_NAN_BATCHES", "1")
+    fi.reset()
+    it = _iter()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    with pytest.raises(MXNetError, match="non-finite gradients"):
+        mod.fit(it, num_epoch=1,
+                optimizer_params={"learning_rate": 0.1})
+
+
+def test_guard_rollback_then_raise(monkeypatch, tmp_path):
+    """rollback escalation: after K consecutive skips the last checkpoint
+    is restored (fit.nonfinite_rollback); a blowup persisting past the
+    rollback raises instead of spinning forever."""
+    monkeypatch.setenv("MXNET_NONFINITE_GUARD", "rollback")
+    monkeypatch.setenv("MXNET_NONFINITE_TOLERANCE", "2")
+    # every batch from epoch 1 on is NaN (4 batches/epoch)
+    monkeypatch.setenv("MXNET_FI_NAN_BATCHES",
+                       ",".join(str(i) for i in range(4, 12)))
+    fi.reset()
+    d = str(tmp_path / "ckpts")
+    r0 = tm.counter("fit.nonfinite_rollback").value
+    it = _iter()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    with pytest.raises(MXNetError, match="persisted after a checkpoint"):
+        mod.fit(it, num_epoch=3,
+                optimizer_params={"learning_rate": 0.1},
+                checkpoint=mx.CheckpointConfig(d, period=1))
+    assert tm.counter("fit.nonfinite_rollback").value == r0 + 1
+
+
+def test_guard_rollback_without_checkpoint_raises(monkeypatch):
+    monkeypatch.setenv("MXNET_NONFINITE_GUARD", "rollback")
+    monkeypatch.setenv("MXNET_NONFINITE_TOLERANCE", "1")
+    monkeypatch.setenv("MXNET_FI_NAN_BATCHES", "1,2,3")
+    fi.reset()
+    it = _iter()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    with pytest.raises(MXNetError, match="no checkpoint"):
+        mod.fit(it, num_epoch=1,
+                optimizer_params={"learning_rate": 0.1})
+
+
+# --- RetryingIter -----------------------------------------------------------
+
+def test_retrying_iter_recovers_transient_failures():
+    base = _iter()
+    ref = [b.data[0].asnumpy() for b in base]
+    base.reset()
+    flaky = fi.FlakyIter(base, raise_at={0, 2})
+    a0 = tm.counter("io.retry.attempts").value
+    it = mx.io.RetryingIter(flaky, max_retries=2, backoff=0.001)
+    got = [b.data[0].asnumpy() for b in it]
+    assert len(got) == len(ref)
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(a, b)
+    assert tm.counter("io.retry.attempts").value == a0 + 2
+    # reset rearms the fault; retry absorbs it again
+    it.reset()
+    assert len([b for b in it]) == len(ref)
+
+
+def test_retrying_iter_gives_up():
+    class AlwaysDown(mx.io.DataIter):
+        def next(self):
+            raise ConnectionError("data service unreachable")
+
+        def reset(self):
+            pass
+
+    g0 = tm.counter("io.retry.giveup").value
+    it = mx.io.RetryingIter(AlwaysDown(), max_retries=2, backoff=0.001)
+    with pytest.raises(ConnectionError):
+        it.next()
+    assert tm.counter("io.retry.giveup").value == g0 + 1
+
+
+def test_fit_retries_flaky_source(monkeypatch):
+    """MXNET_IO_RETRY wraps the training iterator: a source raising a
+    transient IOError once per epoch still completes the fit."""
+    monkeypatch.setenv("MXNET_IO_RETRY", "2")
+    monkeypatch.setenv("MXNET_IO_RETRY_BACKOFF", "0.001")
+    monkeypatch.setenv("MXNET_FI_ITER_RAISE_BATCHES", "1")
+    fi.reset()
+    it = fi.FlakyIter(_iter())
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    a0 = tm.counter("io.retry.attempts").value
+    mod.fit(it, num_epoch=2,
+            optimizer_params={"learning_rate": 0.1})
+    assert tm.counter("io.retry.attempts").value == a0 + 2  # once per epoch

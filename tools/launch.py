@@ -72,6 +72,11 @@ def _worker_env(rank, num_workers, coordinator, num_restarts=0,
         "DMLC_NUM_WORKER": str(num_workers),
         "DMLC_WORKER_ID": str(rank),
     })
+    # supervised jobs should never hang silently in a dead-peer collective:
+    # the kvstore watchdog turns a stalled barrier into a clean exit the
+    # supervisor restarts (and, with MXNET_CHECKPOINT_DIR, a mid-training
+    # resume). Operators can override or disable (0) explicitly.
+    env.setdefault("MXNET_KV_TIMEOUT", "600")
     env.update(job_env or {})
     return env
 
