@@ -45,6 +45,17 @@ gradient sentinel on (``MXNET_NONFINITE_GUARD=skip``) and reports
 ``nonfinite_guard_overhead`` = 1 - guarded/unguarded img/s (expected
 <2%: one all-finite reduce fused into the donated step, no host sync).
 ``BENCH_GUARD=0`` skips it.
+
+``BENCH_MODE=serve`` times the INFERENCE serving path:
+``serving.ModelServer`` (dynamic batcher over per-bucket pre-compiled
+predictors) under ``BENCH_SERVE_CLIENTS`` synthetic concurrent client
+threads, reporting ``serving_throughput`` (img/s), request p50/p99
+latency (from the server's log-bucket histogram), and
+``sequential_img_per_sec`` — the same model driven one request at a time
+through the batch-1 predictor. The batcher must beat sequential
+batch-1 (the smoke pin in tests/test_bench_smoke.py), and the embedded
+telemetry snapshot must show ``executor.jit_compile == 0`` — the warmed
+request path never compiles.
 """
 
 import json
@@ -136,6 +147,115 @@ def _time_warm_start(mx, models, batch_size, image, dtype, num_layers,
     return round(time.time() - tic, 3)
 
 
+def _random_inference_params(mx, sym, image):
+    """Random weights straight from shape inference — binding a training
+    executor just to initialize would compile the whole train graph."""
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=(1,) + image, softmax_label=(1,))
+    rng = np.random.RandomState(0)
+    params = {}
+    for n, s in zip(sym.list_arguments(), arg_shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        fan_in = int(np.prod(s[1:])) if len(s) > 1 else int(s[0])
+        params[f"arg:{n}"] = mx.nd.array(
+            (rng.randn(*s) * np.sqrt(2.0 / max(fan_in, 1)))
+            .astype(np.float32))
+    for n, s in zip(sym.list_auxiliary_states(), aux_shapes):
+        params[f"aux:{n}"] = (mx.nd.ones(s) if "var" in n or "gamma" in n
+                              else mx.nd.zeros(s))
+    return params
+
+
+def _run_serve_mode(mx, models, image, num_layers, on_tpu):
+    import threading
+
+    from mxnet_tpu.serving import ModelServer, ServingConfig
+
+    buckets = os.environ.get("BENCH_SERVE_BUCKETS",
+                             "1,8,32" if on_tpu else "1,4,8")
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+    per_client = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                    50 if on_tpu else 25))
+    seq_iters = int(os.environ.get("BENCH_SERVE_SEQ_ITERS",
+                                   30 if on_tpu else 12))
+
+    sym = models.resnet(num_classes=1000, num_layers=num_layers,
+                        image_shape=",".join(map(str, image)))
+    params = _random_inference_params(mx, sym, image)
+    server = ModelServer(
+        sym, params, {"data": image},
+        config=ServingConfig(buckets=buckets),
+        dev_type="gpu" if on_tpu else "cpu")
+    server.warmup()
+    server.start()
+
+    rng = np.random.RandomState(1)
+    samples = [rng.uniform(-1, 1, image).astype(np.float32)
+               for _ in range(16)]
+
+    # sequential one-request-at-a-time reference through the server's own
+    # smallest-bucket predictor — the exact program the batcher amortizes,
+    # so the ratio isolates the batching win from model/compile
+    # differences (bucket 1 when configured; otherwise one real sample
+    # padded into the smallest bucket, which is what a lone request costs)
+    b0 = server.config.buckets[0]
+    p0 = server.predictor(b0)
+    seq_batch = np.zeros((b0,) + image, np.float32)
+    for s in samples[:2]:
+        seq_batch[0] = s
+        p0.run(data=seq_batch)  # warm
+    tic = time.time()
+    for i in range(seq_iters):
+        seq_batch[0] = samples[i % len(samples)]
+        p0.run(data=seq_batch)
+    sequential = seq_iters / (time.time() - tic)
+
+    mx.telemetry.reset()
+    server.latency.reset()
+    errors = []
+    completed = [0] * clients
+
+    def client(cid):
+        for i in range(per_client):
+            try:
+                server.predict(samples[(cid + i) % len(samples)],
+                               timeout=120)
+                completed[cid] += 1
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    tic = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - tic
+    total = sum(completed)
+    snapshot = mx.telemetry.snapshot()
+    lat = server.latency
+    record = {
+        "metric": f"resnet{num_layers}_serving_throughput"
+                  + ("" if on_tpu else "_cpusmoke"),
+        "value": round(total / wall, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(total / wall / BASELINE_IMG_PER_SEC, 3),
+        "sequential_img_per_sec": round(sequential, 2),
+        "batching_speedup": round(total / wall / sequential, 3),
+        "clients": clients,
+        "requests": total,
+        "errors": len(errors),
+        "p50_ms": round(lat.percentile(50) / 1e3, 2),
+        "p99_ms": round(lat.percentile(99) / 1e3, 2),
+        "telemetry": snapshot,
+    }
+    server.close()
+    print(json.dumps(record))
+
+
 def main():
     import jax
 
@@ -153,6 +273,10 @@ def main():
     windows = max(1, int(os.environ.get("BENCH_WINDOWS", 4 if on_tpu else 1)))
     num_layers = int(os.environ.get("BENCH_LAYERS", 50))
     image = (3, 224, 224) if on_tpu else (3, 64, 64)
+
+    if mode == "serve":
+        _run_serve_mode(mx, models, image, num_layers, on_tpu)
+        return
 
     mod = _build_module(mx, models, batch_size, image, dtype, num_layers,
                         on_tpu)

@@ -98,4 +98,5 @@ from . import contrib
 from . import parallel
 from . import operator
 from . import predictor
+from . import serving
 from . import rtc

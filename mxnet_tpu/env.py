@@ -193,6 +193,36 @@ _declare("MXNET_FI_RANK", int, -1,
          "-1 = every rank.")
 _declare("MXNET_FI_EXIT_CODE", int, 17,
          "Exit code of the injected crash (MXNET_FI_CRASH_AT_BATCH).")
+_declare("MXNET_SERVING_BUCKETS", str, "1,4,16,64",
+         "Comma-separated batch-size buckets for serving.ModelServer: the "
+         "COMPLETE set of inference program shapes. warmup() pre-compiles "
+         "one executable per bucket (persisted via MXNET_AOT_CACHE) and "
+         "the dynamic batcher coalesces requests up to the largest "
+         "bucket, padding partial groups to the smallest covering one — "
+         "the request path never compiles.")
+_declare("MXNET_SERVING_MAX_DELAY_MS", float, 2.0,
+         "Max milliseconds a queued request waits for batch-mates before "
+         "a partial bucket dispatches (the batching deadline — the "
+         "serving throughput/latency dial). 0 disables the coalescing "
+         "wait; requests still batch with whatever queued during the "
+         "previous inference.")
+_declare("MXNET_SERVING_QUEUE_DEPTH", int, 256,
+         "Admission bound for serving.ModelServer: when this many "
+         "requests are already queued, submit() sheds immediately with "
+         "ServerOverloaded (serving.shed counter) instead of queueing "
+         "unboundedly — p99 stays finite under overload.")
+_declare("MXNET_SERVING_DEADLINE_MS", float, 0.0,
+         "Default per-request serving deadline: a request whose deadline "
+         "passes while still queued is dropped with DeadlineExceeded "
+         "(serving.deadline_expired) rather than served after the client "
+         "gave up. 0 (default) = no deadline; per-request deadline_ms "
+         "overrides.")
+_declare("MXNET_SERVING_WATCH", float, 0.0,
+         "Seconds between polls of the serving watch directory's LATEST "
+         "pointer (a PR-4 checkpoint dir): when it names a new "
+         "checkpoint, ModelServer hot-reloads the weights atomically "
+         "between batches without dropping in-flight requests. 0 "
+         "(default) = no watching.")
 _declare("MXNET_XLA_TPU_OPTIONS", str, "",
          "Comma-separated key=value XLA compiler options attached to every "
          "executor program when the target is a TPU (ignored on CPU). The "

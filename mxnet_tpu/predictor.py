@@ -8,13 +8,24 @@ output — no training machinery in the loop.
 TPU-native: a Predictor compiles one inference-only jitted program per input
 shape; ``mx.predictor.Predictor(json, params, shapes)`` mirrors
 ``MXPredCreate``'s signature shape.
+
+Thread safety (the serving batcher's contract): every public method takes
+the predictor's internal re-entrant lock, so individual calls are atomic —
+a batcher worker may drive :meth:`Predictor.forward` while another thread
+hot-swaps weights with :meth:`Predictor.set_params` or re-binds with
+:meth:`Predictor.reshape`. The ``set_input`` → ``forward`` →
+``get_output`` SEQUENCE is *not* atomic across threads; concurrent callers
+must either coordinate externally or use :meth:`Predictor.run`, which
+performs the whole cycle under the lock and returns numpy outputs.
 """
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, np_dtype
 from .context import Context, cpu
 from .executor import Executor
 from .ndarray import NDArray, array, load as nd_load, zeros
@@ -26,8 +37,10 @@ class Predictor:
 
     def __init__(self, symbol_json_or_file, param_source, input_shapes,
                  ctx=None, dev_type="cpu", dev_id=0, output_index=None,
-                 fold_bn=True):
+                 fold_bn=True, input_types=None):
         from .symbol import Symbol
+
+        self._lock = threading.RLock()
 
         if isinstance(symbol_json_or_file, Symbol):
             symbol = symbol_json_or_file
@@ -63,6 +76,17 @@ class Predictor:
                 self.arg_params[k] = v
 
         self.input_shapes = dict(input_shapes)
+        # input dtypes: float32 unless declared (reference MXPredCreateEx
+        # dtype vector) — integer inputs (embedding/token ids) must bind
+        # as integers or large ids silently round through float32
+        self.input_types = {
+            k: np_dtype(v) for k, v in (input_types or {}).items()
+        }
+        unknown_types = set(self.input_types) - set(self.input_shapes)
+        if unknown_types:
+            raise MXNetError(
+                f"input_types names {sorted(unknown_types)} are not inputs "
+                f"(inputs: {sorted(self.input_shapes)})")
         if self._fold_bn:
             # deployment-time optimization: inference BatchNorms collapse
             # into their producer conv/fc (contrib/quantize_fold.py) —
@@ -78,13 +102,22 @@ class Predictor:
         self._bind()
 
     def _bind(self):
-        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**self.input_shapes)
         arg_names = self.symbol.list_arguments()
+        # re-binds (reshape) take caller-supplied shape dicts: an unknown
+        # key would otherwise vanish into infer_shape's kwargs and leave
+        # the REAL input bound at its stale shape — fail by name instead
+        unknown = set(self.input_shapes) - set(arg_names)
+        if unknown:
+            raise MXNetError(
+                f"input_shapes names {sorted(unknown)} are not arguments "
+                f"of this symbol (arguments: {arg_names})")
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**self.input_shapes)
         aux_names = self.symbol.list_auxiliary_states()
         args = {}
         for name, shape in zip(arg_names, arg_shapes):
             if name in self.input_shapes:
-                args[name] = zeros(shape, ctx=self.ctx)
+                args[name] = zeros(shape, ctx=self.ctx,
+                                   dtype=self.input_types.get(name))
             elif name in self.arg_params:
                 if tuple(self.arg_params[name].shape) != tuple(shape):
                     raise MXNetError(
@@ -110,45 +143,140 @@ class Predictor:
         )
 
     def reshape(self, input_shapes):
-        """Re-bind with new input shapes (reference MXPredReshape)."""
-        self.input_shapes = dict(input_shapes)
-        self._partial_outs = None  # computed by the pre-reshape executor
-        self._bind()
+        """Re-bind with new input shapes (reference MXPredReshape).
+
+        Unknown input names raise :class:`MXNetError` (``_bind``
+        validates against ``list_arguments()`` before inferring shapes)
+        rather than silently leaving the real inputs at their old
+        shapes."""
+        with self._lock:
+            old = self.input_shapes
+            self.input_shapes = dict(input_shapes)
+            self._partial_outs = None  # computed by the pre-reshape executor
+            try:
+                self._bind()
+            except MXNetError:
+                self.input_shapes = old  # keep the predictor usable
+                raise
 
     def set_input(self, name, data):
-        if name not in self.input_shapes:
-            raise MXNetError(f"{name!r} is not an input")
-        if not isinstance(data, NDArray):
-            data = array(np.asarray(data, np.float32))
-        data.copyto(self._exec.arg_dict[name])
+        """Write one input. The value is coerced to the BOUND argument's
+        dtype (declared via ``input_types`` or float32), never through a
+        forced float32 round-trip — integer token ids bound as integers
+        stay exact."""
+        with self._lock:
+            if name not in self.input_shapes:
+                raise MXNetError(f"{name!r} is not an input")
+            tgt = self._exec.arg_dict[name]
+            if not isinstance(data, NDArray):
+                data = array(np.asarray(data), dtype=np_dtype(tgt.dtype))
+            data.copyto(tgt)  # copyto casts NDArray sources to tgt dtype
 
     def forward(self, **kwargs):
-        for k, v in kwargs.items():
-            self.set_input(k, v)
-        self._partial_outs = None
-        self._exec.forward(is_train=False)
+        with self._lock:
+            for k, v in kwargs.items():
+                self.set_input(k, v)
+            self._partial_outs = None
+            self._exec.forward(is_train=False)
+
+    def run(self, **inputs):
+        """Atomic set-inputs → forward → fetch: the whole cycle under the
+        predictor lock (the serving batcher's entry point — interleaved
+        callers can never mix inputs and outputs of different requests).
+        Returns the outputs as numpy arrays."""
+        with self._lock:
+            self.forward(**inputs)
+            return [self.get_output(i) for i in range(self.num_outputs)]
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False):
+        """Hot-swap weight VALUES into the bound executor without
+        re-binding or recompiling (shapes/dtypes must match the bound
+        program). The serving hot-reload path: called under the batcher's
+        run lock, so a swap lands between forwards and every forward
+        computes against exactly one weight set.
+
+        Every non-input bound argument must be present unless
+        ``allow_missing`` (a half-swapped net silently mixes versions —
+        the failure mode this raises on). Also updates the stored
+        ``arg_params``/``aux_params`` so a later :meth:`reshape` re-binds
+        with the new weights."""
+        aux_params = aux_params or {}
+        with self._lock:
+            missing = [n for n in self._exec.arg_names
+                       if n not in self.input_shapes
+                       and n not in arg_params and n in self.arg_params]
+            if missing and not allow_missing:
+                raise MXNetError(
+                    f"set_params: missing {len(missing)} bound params "
+                    f"(e.g. {missing[:3]}); pass allow_missing=True to "
+                    "keep current values for them")
+            # two-phase: validate/convert EVERY entry before the first
+            # copyto — a mid-loop failure (unknown key, shape mismatch)
+            # must leave the bound net untouched, not half-swapped (the
+            # reload contract: failed reloads keep old weights live)
+            arg_swaps, aux_swaps = [], []
+            for name, v in arg_params.items():
+                if name in self.input_shapes:
+                    continue
+                if name not in self._exec.arg_dict:
+                    raise MXNetError(f"set_params: {name!r} is not a "
+                                     "bound argument")
+                tgt = self._exec.arg_dict[name]
+                arg_swaps.append((tgt, name, self._check_one(tgt, name, v)))
+            for name, v in aux_params.items():
+                if name not in self._exec.aux_dict:
+                    continue  # folded-out BN stats etc.
+                tgt = self._exec.aux_dict[name]
+                aux_swaps.append((tgt, name, self._check_one(tgt, name, v)))
+            for tgt, name, v in arg_swaps:
+                v.copyto(tgt)
+                self.arg_params[name] = v
+            for tgt, name, v in aux_swaps:
+                v.copyto(tgt)
+                self.aux_params[name] = v
+            self._partial_outs = None
+
+    @staticmethod
+    def _check_one(tgt, name, v):
+        if not isinstance(v, NDArray):
+            v = array(np.asarray(v), dtype=np_dtype(tgt.dtype))
+        if tuple(v.shape) != tuple(tgt.shape):
+            raise MXNetError(
+                f"set_params: {name} shape mismatch: bound "
+                f"{tuple(tgt.shape)}, new {tuple(v.shape)}")
+        return v
 
     def _current_outputs(self):
         outs = getattr(self, "_partial_outs", None)
         return outs if outs is not None else self._exec.outputs
 
     def get_output(self, index):
-        return self._current_outputs()[index].asnumpy()
+        with self._lock:
+            return self._current_outputs()[index].asnumpy()
 
     @property
     def num_outputs(self):
-        return len(self._current_outputs())
+        with self._lock:
+            return len(self._current_outputs())
 
     # --- flat-buffer accessors used by the C predict shim ----------------
     # (mxnet_tpu/native/c_predict_api.cpp marshals raw float32 buffers
     # across the ABI like the reference MXPredSetInput/MXPredGetOutput)
     def set_input_bytes(self, name, buf):
-        shape = self.input_shapes[name]
-        arr = np.frombuffer(buf, np.float32).reshape(shape)
-        self.set_input(name, arr)
+        with self._lock:
+            if name not in self.input_shapes:
+                raise MXNetError(f"{name!r} is not an input")
+            shape = self.input_shapes[name]
+            # the buffer is read in the BOUND dtype (not forced float32):
+            # an int32-bound token-id input takes int32 bytes across the
+            # ABI — reinterpreting ids as floats would corrupt them
+            dt = np_dtype(self._exec.arg_dict[name].dtype)
+            arr = np.frombuffer(buf, dt).reshape(shape)
+            self.set_input(name, arr)
 
     def get_output_shape(self, index):
-        return tuple(self._current_outputs()[index].shape)
+        with self._lock:
+            return tuple(self._current_outputs()[index].shape)
 
     def get_output_bytes(self, index):
         out = self.get_output(index)
@@ -162,11 +290,13 @@ class Predictor:
         re-interprets the prefix from scratch (as the un-jitted reference
         debug path does), so a full 0..N walk costs O(N^2) op runs — jump
         straight to the step of interest for large graphs."""
-        total = sum(1 for nd in self._exec.graph.topo if not nd.is_variable)
-        n = min(step + 1, total)
-        self._partial_outs = self._exec.partial_forward(
-            is_train=False, num_nodes=n)
-        return total - n
+        with self._lock:
+            total = sum(
+                1 for nd in self._exec.graph.topo if not nd.is_variable)
+            n = min(step + 1, total)
+            self._partial_outs = self._exec.partial_forward(
+                is_train=False, num_nodes=n)
+            return total - n
 
 
 def create_predictor_partial(symbol_json, param_bytes, input_shapes,

@@ -1,0 +1,39 @@
+"""Model serving: dynamic batching, bucketed AOT inference, load
+shedding, hot reload.
+
+The inference-side pillar of the framework. The reference's deployment
+story stopped at the single-request C predict ABI
+(``c_predict_api``/amalgamation → :mod:`mxnet_tpu.predictor`); production
+TPU serving is won one layer up, where this package lives:
+
+- :class:`DynamicBatcher` coalesces concurrent requests into a small,
+  closed set of padded batch-size buckets under a max-queue-delay
+  deadline — throughput scales with the bucket, latency stays bounded by
+  the delay.
+- :class:`ModelServer` pre-compiles one inference executable per bucket
+  (:meth:`ModelServer.warmup`, persisted via the AOT executable cache
+  when ``MXNET_AOT_CACHE=1``) so the request path NEVER compiles; admits
+  requests through a bounded queue that sheds
+  (:class:`ServerOverloaded`) instead of building unbounded latency; and
+  hot-swaps weights between batches (:meth:`ModelServer.reload`, or
+  ``MXNET_SERVING_WATCH`` polling a checkpoint directory's ``LATEST``
+  pointer) without dropping in-flight requests.
+- :func:`serve_http` / ``tools/serve.py`` expose it over a stdlib
+  threaded HTTP frontend (``POST /predict``, ``GET /healthz``,
+  ``GET /metrics`` Prometheus text).
+
+See ``docs/serving.md`` for architecture and tuning.
+"""
+
+from .batcher import DynamicBatcher
+from .errors import (DeadlineExceeded, ServerClosed, ServerOverloaded,
+                     ServingError)
+from .http import make_http_server, serve_http
+from .metrics import LatencyHistogram
+from .server import ModelServer, ServingConfig
+
+__all__ = [
+    "DynamicBatcher", "LatencyHistogram", "ModelServer", "ServingConfig",
+    "ServingError", "ServerOverloaded", "DeadlineExceeded", "ServerClosed",
+    "make_http_server", "serve_http",
+]

@@ -1,0 +1,269 @@
+"""Dynamic request batching: coalesce concurrent requests into bucketed
+padded batches.
+
+Clipper/TF-Serving-style adaptive batching in front of pre-compiled
+executables: requests queue; a single worker thread coalesces whatever has
+arrived — waiting at most ``max_delay`` after the oldest queued request —
+pads the group up to the smallest configured bucket batch size, runs ONE
+inference, and scatters the output rows back to per-request futures. The
+bucket set is closed, so a warmed server never sees a new program shape on
+the request path (the TPU serving rule: never trace/compile behind a
+request).
+
+Admission is bounded: when ``queue_depth`` requests are already waiting,
+``submit`` rejects fast with :class:`ServerOverloaded` instead of letting
+the queue (and every queued request's latency) grow without bound —
+shedding at admission is the only load response that keeps p99 finite.
+
+The batcher is model-agnostic: ``runner(bucket, stacked, n_valid)``
+receives each input stacked batch-major and zero-padded to ``bucket`` rows
+and returns the output arrays batch-major; only rows ``< n_valid`` are
+scattered. ``ModelServer`` supplies a runner that drives the per-bucket
+:class:`~mxnet_tpu.predictor.Predictor`.
+
+Telemetry: ``serving.request`` / ``serving.shed`` /
+``serving.deadline_expired`` / ``serving.batches`` counters, the
+``serving.batch_size`` / ``serving.pad_waste`` / ``serving.queue_wait``
+histograms (queue_wait in µs), the ``serving.infer`` span and the
+``serving.queue_depth`` gauge.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from .. import telemetry as _tm
+from .errors import DeadlineExceeded, ServerClosed, ServerOverloaded
+
+__all__ = ["DynamicBatcher"]
+
+
+class _Request:
+    __slots__ = ("inputs", "future", "t_enqueue", "deadline")
+
+    def __init__(self, inputs, deadline):
+        self.inputs = inputs
+        self.future = Future()
+        self.t_enqueue = time.monotonic()
+        self.deadline = deadline  # absolute monotonic seconds, or None
+
+
+def _fail(future, exc):
+    """set_exception tolerating client-side cancel(): an unguarded set on
+    a CANCELLED future raises InvalidStateError and would kill the single
+    batcher worker — bricking the server."""
+    if future.set_running_or_notify_cancel():
+        future.set_exception(exc)
+
+
+class DynamicBatcher:
+    """Coalesces submitted requests into padded bucket-sized batches.
+
+    Parameters
+    ----------
+    runner : callable
+        ``runner(bucket, stacked, n_valid) -> sequence of np.ndarray``.
+        ``stacked`` maps input name -> ``(bucket, *sample_shape)`` array
+        (rows ``>= n_valid`` are zero padding); outputs are batch-major.
+    buckets : sequence of int
+        Allowed batch sizes, e.g. ``(1, 4, 16, 64)``. A group of ``n``
+        requests runs at the smallest bucket ``>= n``; the largest bucket
+        caps how many requests one batch takes.
+    max_delay : float
+        Seconds the worker waits for more requests after the oldest queued
+        one before dispatching a partial bucket (the batching deadline).
+    queue_depth : int
+        Admission bound: ``submit`` sheds when this many requests wait.
+    latency_observer : callable or None
+        Called with the request's total latency in µs when its future
+        resolves successfully (feeds the server's p50/p99 histogram).
+    """
+
+    def __init__(self, runner, buckets, max_delay=0.002, queue_depth=256,
+                 latency_observer=None):
+        buckets = sorted(set(int(b) for b in buckets))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"invalid bucket set {buckets!r}")
+        self._runner = runner
+        self.buckets = tuple(buckets)
+        self.max_delay = float(max_delay)
+        self.queue_depth = int(queue_depth)
+        self._latency_observer = latency_observer
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._stopping = False
+        self._worker = None
+        # serializes inference against weight swaps: ModelServer.reload
+        # acquires this lock so a swap lands BETWEEN batches — no batch
+        # ever computes with half-updated weights and no in-flight
+        # request is dropped
+        self.run_lock = threading.Lock()
+        # optional: called under run_lock right after the runner returns;
+        # its dict is set as attributes on every future of the batch
+        # (e.g. the weight version the batch computed against — reading
+        # it from the server AFTER the future resolves would race reload)
+        self.annotate = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self):
+        if self._worker is not None:
+            return
+        self._worker = threading.Thread(
+            target=self._run, name="serving-batcher", daemon=True)
+        self._worker.start()
+
+    @property
+    def running(self):
+        return self._worker is not None and not self._stopping
+
+    def stop(self, drain=True, timeout=30.0):
+        """Stop accepting work. ``drain=True`` serves everything already
+        queued first; ``drain=False`` fails queued requests with
+        :class:`ServerClosed`. Joins the worker."""
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                while self._queue:
+                    req = self._queue.popleft()
+                    _fail(req.future, ServerClosed(
+                        "server closed before this request ran"))
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout)
+            self._worker = None
+
+    # -- admission -----------------------------------------------------
+    def submit(self, inputs, deadline=None):
+        """Enqueue one request; returns its ``concurrent.futures.Future``.
+
+        ``inputs``: dict name -> per-sample numpy array (already validated
+        and dtype-coerced by the caller). ``deadline``: absolute
+        ``time.monotonic()`` seconds after which the request is dropped
+        unserved, or None. Raises :class:`ServerClosed` /
+        :class:`ServerOverloaded` without queueing.
+        """
+        req = _Request(inputs, deadline)
+        with self._cond:
+            if self._stopping or self._worker is None:
+                raise ServerClosed("server is not accepting requests")
+            if len(self._queue) >= self.queue_depth:
+                _tm.counter("serving.shed").inc()
+                raise ServerOverloaded(
+                    f"admission queue full ({self.queue_depth} waiting); "
+                    "request shed")
+            self._queue.append(req)
+            depth = len(self._queue)
+            self._cond.notify()
+        _tm.counter("serving.request").inc()
+        _tm.gauge("serving.queue_depth").set(depth)
+        return req.future
+
+    # -- worker --------------------------------------------------------
+    def _take(self):
+        """Block for the next group of requests (None = stopped + drained).
+
+        Coalescing rule: once the queue is non-empty, wait until either
+        the largest bucket fills or ``max_delay`` has elapsed since the
+        OLDEST queued request — so no request's batching wait exceeds
+        max_delay. While draining, dispatch immediately."""
+        with self._cond:
+            while not self._queue and not self._stopping:
+                self._cond.wait()
+            if not self._queue:
+                return None
+            max_b = self.buckets[-1]
+            if not self._stopping:
+                while len(self._queue) < max_b and not self._stopping:
+                    # the coalescing wait must never outlive a queued
+                    # request's deadline: a lone request whose deadline is
+                    # shorter than max_delay dispatches (slightly early)
+                    # instead of expiring on an idle server. Recomputed
+                    # each wake — new arrivals can carry earlier deadlines
+                    dispatch_at = self._queue[0].t_enqueue + self.max_delay
+                    for r in self._queue:
+                        if r.deadline is not None:
+                            dispatch_at = min(dispatch_at,
+                                              r.deadline - 1e-3)
+                    remaining = dispatch_at - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            take = min(len(self._queue), max_b)
+            reqs = [self._queue.popleft() for _ in range(take)]
+            _tm.gauge("serving.queue_depth").set(len(self._queue))
+        return reqs
+
+    def _run(self):
+        while True:
+            reqs = self._take()
+            if reqs is None:
+                return
+            now = time.monotonic()
+            live = []
+            for r in reqs:
+                _tm.histogram("serving.queue_wait").observe(
+                    (now - r.t_enqueue) * 1e6)
+                if r.deadline is not None and now > r.deadline:
+                    _tm.counter("serving.deadline_expired").inc()
+                    _fail(r.future, DeadlineExceeded(
+                        "deadline expired after "
+                        f"{(now - r.t_enqueue) * 1e3:.1f} ms in queue"))
+                else:
+                    live.append(r)
+            if live:
+                self._run_batch(live)
+
+    def _pick_bucket(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]  # _take caps n at the largest bucket
+
+    def _run_batch(self, reqs):
+        n = len(reqs)
+        bucket = self._pick_bucket(n)
+        try:
+            stacked = {}
+            for name, sample in reqs[0].inputs.items():
+                rows = [r.inputs[name] for r in reqs]
+                batch = np.stack(rows)
+                if n < bucket:
+                    pad = np.zeros((bucket - n,) + sample.shape,
+                                   dtype=sample.dtype)
+                    batch = np.concatenate([batch, pad])
+                stacked[name] = batch
+            with self.run_lock:
+                with _tm.span("serving.infer", bucket=bucket, valid=n):
+                    outs = self._runner(bucket, stacked, n)
+                note = self.annotate() if self.annotate else None
+        except BaseException as e:  # noqa: BLE001 — fanned out per request
+            for r in reqs:
+                _fail(r.future, e)
+            return
+        _tm.counter("serving.batches").inc()
+        _tm.histogram("serving.batch_size").observe(n)
+        _tm.histogram("serving.pad_waste").observe(bucket - n)
+        done = time.monotonic()
+        for i, r in enumerate(reqs):
+            lat_us = (done - r.t_enqueue) * 1e6
+            _tm.histogram("serving.latency").observe(lat_us)
+            if self._latency_observer is not None:
+                self._latency_observer(lat_us)
+            # which program shape served this request: responses are
+            # bitwise-deterministic PER BUCKET (XLA codegen is
+            # shape-specialized), so reproducibility audits need the
+            # bucket next to the result
+            r.future.bucket = bucket
+            if note:
+                for k, v in note.items():
+                    setattr(r.future, k, v)
+            if r.future.set_running_or_notify_cancel():
+                # copy the rows out: a view would pin the whole padded
+                # bucket-sized output batch for as long as the client
+                # keeps the response
+                r.future.set_result([np.array(o[i]) for o in outs])

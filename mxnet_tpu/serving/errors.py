@@ -1,0 +1,36 @@
+"""Typed serving errors.
+
+All subclass :class:`~mxnet_tpu.base.MXNetError` so existing callers that
+catch the framework's base error keep working; the HTTP frontend maps each
+to a distinct status code (503/504) so clients can tell "back off" from
+"give up".
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["ServingError", "ServerOverloaded", "DeadlineExceeded",
+           "ServerClosed"]
+
+
+class ServingError(MXNetError):
+    """Base class of every serving-subsystem error."""
+
+
+class ServerOverloaded(ServingError):
+    """The admission queue is full — the request was shed (reject-fast,
+    never queued). Clients should back off and retry; the HTTP frontend
+    returns 503 with a Retry-After hint. Counted in ``serving.shed``."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline expired while it waited in the queue; it was
+    dropped without running inference (the work would be wasted — the
+    client has already given up). HTTP 504."""
+
+
+class ServerClosed(ServingError):
+    """The server is draining or closed and accepts no new requests.
+    In-flight and already-queued requests still complete (graceful
+    drain)."""

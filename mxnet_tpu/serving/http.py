@@ -1,0 +1,191 @@
+"""Stdlib-only threaded HTTP frontend for :class:`ModelServer`.
+
+One thread per connection (``ThreadingHTTPServer``); every request thread
+just validates, submits to the batcher and blocks on its future — the
+batching layer, not the HTTP layer, owns concurrency. Endpoints:
+
+- ``POST /predict`` — ``application/json`` body ``{"inputs": {name:
+  nested-list}, "deadline_ms": optional}`` (or the inputs dict directly);
+  response ``{"outputs": [...], "shapes": [...], "version": n}``. For
+  single-input models, ``application/octet-stream`` bodies are raw
+  little-endian sample bytes in the input's bound dtype; with ``Accept:
+  application/octet-stream`` the response is output 0's raw float32 bytes
+  (``X-Output-Shape`` header).
+- ``GET /healthz`` — ``ModelServer.stats()`` JSON; 503 while draining.
+- ``GET /metrics`` — Prometheus text from the PR-2 telemetry registry
+  (every ``mxnet_serving_*`` instrument plus the rest of the framework).
+
+Error mapping: 400 malformed request, 503 ``ServerOverloaded`` (with
+``Retry-After``) / ``ServerClosed``, 504 ``DeadlineExceeded``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .. import telemetry as _tm
+from ..base import MXNetError
+from .errors import DeadlineExceeded, ServerClosed, ServerOverloaded
+
+__all__ = ["make_http_server", "serve_http"]
+
+_LOG = logging.getLogger("mxnet_tpu.serving.http")
+
+
+def _make_handler(model_server):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "mxnet-tpu-serving"
+
+        def log_message(self, fmt, *args):  # route to logging, not stderr
+            _LOG.debug("%s %s", self.address_string(), fmt % args)
+
+        # -- helpers ---------------------------------------------------
+        def _send(self, code, body, ctype="application/json",
+                  headers=None):
+            if isinstance(body, (dict, list)):
+                body = json.dumps(body).encode()
+            elif isinstance(body, str):
+                body = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, code, msg, headers=None):
+            self._send(code, {"error": msg}, headers=headers)
+
+        # -- GET -------------------------------------------------------
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            if self.path == "/healthz":
+                stats = model_server.stats()
+                code = 200 if stats["status"] == "ok" else 503
+                self._send(code, stats)
+            elif self.path == "/metrics":
+                self._send(200, _tm.prometheus(),
+                           ctype="text/plain; version=0.0.4")
+            else:
+                self._error(404, f"unknown path {self.path}")
+
+        # -- POST ------------------------------------------------------
+        def do_POST(self):  # noqa: N802
+            if self.path != "/predict":
+                # drain the body first: on a keep-alive (HTTP/1.1)
+                # connection an unread body would be parsed as the NEXT
+                # request line, corrupting the connection for the client
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                self._error(404, f"unknown path {self.path}")
+                return
+            _tm.counter("serving.http.request").inc()
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                ctype = (self.headers.get("Content-Type") or
+                         "application/json").split(";")[0].strip()
+                inputs, deadline_ms, raw_out = self._parse(body, ctype)
+                fut = model_server.submit(inputs, deadline_ms=deadline_ms)
+                outs = fut.result()
+            except ServerOverloaded as e:
+                _tm.counter("serving.http.shed").inc()
+                self._error(503, str(e), headers={"Retry-After": "1"})
+            except DeadlineExceeded as e:
+                self._error(504, str(e))
+            except ServerClosed as e:
+                self._error(503, str(e))
+            except (MXNetError, ValueError, KeyError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._error(400, str(e))
+            except Exception as e:  # noqa: BLE001 — inference-time errors
+                # (e.g. XlaRuntimeError) surface as 500, not a dropped
+                # connection — the error contract must hold for every
+                # exception a batcher future can carry
+                _LOG.exception("predict failed")
+                self._error(500, f"{type(e).__name__}: {e}")
+            else:
+                if raw_out:
+                    payload = np.ascontiguousarray(
+                        outs[0], np.float32).tobytes()
+                    self._send(200, payload,
+                               ctype="application/octet-stream",
+                               headers={"X-Output-Shape": ",".join(
+                                   map(str, outs[0].shape))})
+                else:
+                    self._send(200, {
+                        "outputs": [o.tolist() for o in outs],
+                        "shapes": [list(o.shape) for o in outs],
+                        # the version the BATCH computed against (stamped
+                        # under the run lock) — model_server.version may
+                        # already have moved on under concurrent reload
+                        "version": getattr(fut, "version",
+                                           model_server.version),
+                    })
+
+        def _parse(self, body, ctype):
+            raw_out = "application/octet-stream" in (
+                self.headers.get("Accept") or "")
+            if ctype == "application/octet-stream":
+                names = model_server._input_names
+                name = self.headers.get("X-Input-Name") or names[0]
+                if name not in names:
+                    raise MXNetError(f"unknown input {name!r}")
+                shape = model_server._sample_shapes[name]
+                dtype = model_server._input_dtypes[name]
+                arr = np.frombuffer(body, dtype=dtype)
+                if arr.size != int(np.prod(shape)):
+                    raise MXNetError(
+                        f"raw body holds {arr.size} {dtype} elements; "
+                        f"input {name!r} needs shape {shape}")
+                return {name: arr.reshape(shape)}, None, True
+            payload = json.loads(body or b"{}")
+            if not isinstance(payload, dict):
+                raise MXNetError("JSON body must be an object")
+            # pop BEFORE falling back to the direct-inputs form, where the
+            # payload itself is the inputs dict — a leftover deadline_ms
+            # key would be rejected as an unknown input name
+            deadline_ms = payload.pop("deadline_ms", None)
+            inputs = payload.get("inputs", payload)
+            return inputs, deadline_ms, raw_out
+
+    return Handler
+
+
+class _ServingHTTPServer(ThreadingHTTPServer):
+    # socketserver's default listen backlog is 5: a burst of concurrent
+    # clients beyond that gets kernel connection RESETS before the
+    # admission controller ever sees them. The backlog must comfortably
+    # exceed the batcher's queue depth — shedding is the server's job,
+    # not the SYN queue's.
+    request_queue_size = 1024
+
+
+def make_http_server(model_server, host="0.0.0.0", port=8080):
+    """A ``ThreadingHTTPServer`` bound to ``host:port`` and wired to
+    ``model_server`` (not yet serving — call ``serve_forever`` or use
+    :func:`serve_http`)."""
+    return _ServingHTTPServer((host, port), _make_handler(model_server))
+
+
+def serve_http(model_server, host="0.0.0.0", port=8080):
+    """Start the model server and block serving HTTP until interrupted;
+    drains gracefully on shutdown (queued requests complete, the listener
+    refuses new ones)."""
+    model_server.start()
+    httpd = make_http_server(model_server, host, port)
+    _LOG.info("serving on http://%s:%d (buckets %s)", host, port,
+              list(model_server.config.buckets))
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        model_server.close(drain=True)
+    return httpd

@@ -1,0 +1,522 @@
+"""ModelServer: bucketed AOT inference with admission control and hot
+reload.
+
+The serving pillar of the framework (ROADMAP: "serves heavy traffic from
+millions of users"). A :class:`ModelServer` owns one
+:class:`~mxnet_tpu.predictor.Predictor` per configured bucket batch size,
+all sharing one folded symbol and one set of device-resident weights, plus
+a :class:`~mxnet_tpu.serving.batcher.DynamicBatcher` that coalesces
+concurrent requests into those fixed shapes. The contract that wins TPU
+serving latency: **the bucket set is the complete program universe** —
+:meth:`warmup` compiles every bucket (persisting executables through the
+PR-3 AOT cache when ``MXNET_AOT_CACHE`` is on) before the first request is
+admitted, so the request path never traces or compiles
+(``executor.jit_compile`` stays at its warmup value; counter-verified in
+``tests/test_serving.py``).
+
+Hot reload (:meth:`reload`) swaps weights from a PR-4 checkpoint directory
+(digest-verified ``checkpoint.load_latest``), a ``.params`` file, or an
+in-memory dict — atomically between batches (the batcher's run lock), so
+in-flight requests complete against a consistent weight set and nothing is
+dropped. ``MXNET_SERVING_WATCH`` (or ``ServingConfig(watch_dir=...)``)
+polls the checkpoint ``LATEST`` pointer and reloads on change — the
+train→serve hand-off needs no orchestration beyond the trainer committing
+checkpoints.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+
+from .. import env as _env
+from .. import telemetry as _tm
+from ..base import MXNetError
+from .batcher import DynamicBatcher
+from .errors import ServerClosed
+from .metrics import LatencyHistogram
+
+__all__ = ["ServingConfig", "ModelServer"]
+
+
+def _parse_buckets(raw):
+    try:
+        buckets = sorted({int(b) for b in str(raw).split(",") if b.strip()})
+    except ValueError as e:
+        raise MXNetError(f"bad bucket list {raw!r}: {e}") from e
+    if not buckets or buckets[0] < 1:
+        raise MXNetError(f"bad bucket list {raw!r}")
+    return tuple(buckets)
+
+
+class ServingConfig:
+    """Serving policy. Every knob defaults from its ``MXNET_SERVING_*``
+    env var so deployments tune without code changes.
+
+    Parameters
+    ----------
+    buckets : sequence of int or str
+        Batch-size buckets (the complete set of compiled program shapes).
+    max_delay_ms : float
+        Max milliseconds a request waits for batch-mates before a partial
+        bucket dispatches. The throughput/latency dial: 0 disables
+        coalescing beyond what queues naturally during inference.
+    queue_depth : int
+        Admission bound; a full queue sheds (``ServerOverloaded``).
+    deadline_ms : float
+        Default per-request deadline (0 = none). A request whose deadline
+        passes while queued is dropped with ``DeadlineExceeded``.
+    watch_dir : str or None
+        Checkpoint directory to poll for hot reload (the ``LATEST``
+        pointer file).
+    watch_period : float
+        Poll interval seconds for ``watch_dir`` (0 = no watching).
+    fold_bn : bool
+        Fold inference BatchNorms into their producers once, server-wide
+        (same deployment optimization the Predictor applies).
+    """
+
+    __slots__ = ("buckets", "max_delay", "queue_depth", "deadline",
+                 "watch_dir", "watch_period", "fold_bn")
+
+    def __init__(self, buckets=None, max_delay_ms=None, queue_depth=None,
+                 deadline_ms=None, watch_dir=None, watch_period=None,
+                 fold_bn=True):
+        if buckets is None:
+            buckets = _env.get("MXNET_SERVING_BUCKETS")
+        if isinstance(buckets, str):
+            buckets = _parse_buckets(buckets)
+        else:
+            buckets = _parse_buckets(",".join(map(str, buckets)))
+        self.buckets = buckets
+        if max_delay_ms is None:
+            max_delay_ms = _env.get("MXNET_SERVING_MAX_DELAY_MS")
+        self.max_delay = max(0.0, float(max_delay_ms)) / 1e3
+        if queue_depth is None:
+            queue_depth = _env.get("MXNET_SERVING_QUEUE_DEPTH")
+        self.queue_depth = max(1, int(queue_depth))
+        if deadline_ms is None:
+            deadline_ms = _env.get("MXNET_SERVING_DEADLINE_MS")
+        self.deadline = max(0.0, float(deadline_ms)) / 1e3
+        self.watch_dir = os.fspath(watch_dir) if watch_dir else None
+        if watch_period is None:
+            watch_period = _env.get("MXNET_SERVING_WATCH")
+        self.watch_period = max(0.0, float(watch_period))
+        self.fold_bn = bool(fold_bn)
+
+
+def _load_params(source):
+    """``(arg_params, aux_params, commit)`` from a params dict (plain or
+    ``arg:``/``aux:``-prefixed), a ``.params`` file, a param blob, or a
+    PR-4 checkpoint directory (digest-verified, falls back past corrupt
+    commits). ``commit`` is the checkpoint name actually loaded (what the
+    hot-reload watcher marks as seen), None for non-directory sources."""
+    from ..ndarray import load as nd_load
+
+    if isinstance(source, (str, os.PathLike)):
+        source = os.fspath(source)
+        if os.path.isdir(source):
+            from ..checkpoint import load_latest
+
+            loaded = load_latest(source)
+            if loaded is None:
+                raise MXNetError(
+                    f"no valid checkpoint under {source!r}")
+            return (dict(loaded.arg_params), dict(loaded.aux_params),
+                    os.path.basename(loaded.path))
+        params = nd_load(source)
+    elif isinstance(source, bytes):
+        from ..ndarray import load_buffer
+
+        params = load_buffer(source)
+    else:
+        params = source
+    arg_params, aux_params = {}, {}
+    for k, v in params.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params, None
+
+
+class ModelServer:
+    """Batched, bucketed, overload-protected inference server.
+
+    Parameters
+    ----------
+    symbol : Symbol, json str, or symbol file path
+        The model graph (resolved exactly like ``Predictor``).
+    params : dict / ``.params`` path / param blob / checkpoint directory
+        Initial weights (see :func:`_load_params`).
+    input_shapes : dict name -> per-SAMPLE shape
+        e.g. ``{"data": (3, 224, 224)}`` — no batch dimension; the bucket
+        predictors prepend their batch sizes.
+    config : ServingConfig or None
+        None reads the ``MXNET_SERVING_*`` defaults.
+    input_types : dict name -> dtype, optional
+        Input dtypes (token-id inputs should be integer — forwarded to
+        each bucket ``Predictor``).
+
+    Lifecycle: ``warmup()`` (compile every bucket) → ``start()`` (accept
+    traffic; implies warmup) → ``submit``/``predict`` → ``close()``
+    (drain + stop). ``reload()`` may be called at any point while serving.
+    """
+
+    def __init__(self, symbol, params, input_shapes, config=None, ctx=None,
+                 dev_type="cpu", dev_id=0, input_types=None, logger=None):
+        from ..predictor import Predictor
+        from ..symbol import Symbol, fromjson, load as sym_load
+
+        from ..context import Context
+
+        self.config = config or ServingConfig()
+        self.logger = logger or logging.getLogger("mxnet_tpu.serving")
+        if isinstance(symbol, Symbol):
+            sym = symbol
+        elif isinstance(symbol, str) and symbol.lstrip().startswith("{"):
+            sym = fromjson(symbol)
+        else:
+            sym = sym_load(symbol)
+        arg_params, aux_params, loaded_commit = _load_params(params)
+        self._orig_symbol = sym  # reload must re-fold from the raw graph
+        self._symbol, arg_params, aux_params = self._fold(
+            sym, arg_params, aux_params)
+        self._sample_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        self._input_names = tuple(self._sample_shapes)
+        self._input_types = dict(input_types or {})
+        self._ctx = ctx or Context(dev_type, dev_id)
+        # move weights to the device ONCE: every bucket predictor's _bind
+        # then binds the same device-resident arrays (as_in_context is a
+        # no-op in-context) instead of copying the full weight set per
+        # bucket — one HBM copy and one host→device transfer, not
+        # len(buckets) of each
+        arg_params = self._to_ctx(arg_params)
+        aux_params = self._to_ctx(aux_params)
+
+        self._predictors = {}
+        for b in self.config.buckets:
+            shapes = {n: (b,) + s for n, s in self._sample_shapes.items()}
+            self._predictors[b] = Predictor(
+                self._symbol, self._combined(arg_params, aux_params),
+                shapes, ctx=self._ctx,
+                fold_bn=False, input_types=self._input_types or None)
+        from ..base import np_dtype
+
+        p1 = self._predictors[self.config.buckets[0]]
+        # np_dtype, not np.dtype(str(...)): 'bfloat16' is a framework
+        # dtype that numpy's parser does not know
+        self._input_dtypes = {
+            n: np_dtype(p1._exec.arg_dict[n].dtype)
+            for n in self._input_names
+        }
+        self.latency = LatencyHistogram()
+        self._batcher = DynamicBatcher(
+            self._infer, self.config.buckets,
+            max_delay=self.config.max_delay,
+            queue_depth=self.config.queue_depth,
+            latency_observer=self.latency.observe_us,
+        )
+        # stamp each future with the weight version its batch computed
+        # against (read under the run lock — reload bumps version under
+        # the same lock, so the label can never be a version the batch
+        # did not actually use)
+        self._batcher.annotate = lambda: {"version": self.version}
+        self._warm = False
+        self._closed = False
+        self.version = 0  # bumps on every successful reload
+        self._watcher = None
+        self._watch_stop = threading.Event()
+        # which checkpoint commit the served weights came from: set only
+        # when the initial params were loaded from the watched directory
+        # itself — anything newer (including a commit landing between now
+        # and start()) must trigger a reload, and initial weights from a
+        # different source mean the watch dir is entirely unseen
+        self._latest_seen = (
+            loaded_commit if self._is_watch_dir(params) else None)
+
+    # -- construction helpers ------------------------------------------
+    def _fold(self, sym, arg_params, aux_params):
+        """Fold inference BatchNorms ONCE at the server level; every
+        bucket predictor then shares the folded symbol and weights (the
+        per-predictor fold would redo the same arithmetic per bucket).
+        Reload re-runs the same fold so swapped weights stay consistent
+        with the folded graph."""
+        self._fold_active = False
+        if not self.config.fold_bn:
+            return sym, arg_params, aux_params
+        from ..contrib import fold_batchnorm
+
+        try:
+            folded_sym, folded_args = fold_batchnorm(
+                sym, arg_params, aux_params)
+        except MXNetError:
+            # malformed/partial param sets: serve unfolded (the private
+            # flag — NOT the caller's shareable config — remembers, so
+            # reload doesn't fold into an unfolded graph)
+            return sym, arg_params, aux_params
+        self._fold_active = True
+        return folded_sym, folded_args, aux_params
+
+    def _to_ctx(self, params):
+        from ..ndarray import NDArray
+
+        return {k: v.as_in_context(self._ctx)
+                if isinstance(v, NDArray) else v
+                for k, v in params.items()}
+
+    def _is_watch_dir(self, source):
+        if not self.config.watch_dir or not isinstance(
+                source, (str, os.PathLike)):
+            return False
+        return os.path.abspath(os.fspath(source)) == \
+            os.path.abspath(self.config.watch_dir)
+
+    @staticmethod
+    def _combined(arg_params, aux_params):
+        d = {f"arg:{k}": v for k, v in arg_params.items()}
+        d.update({f"aux:{k}": v for k, v in aux_params.items()})
+        return d
+
+    def predictor(self, bucket):
+        """The bucket's underlying Predictor (benchmarks/tests; do not
+        drive it while traffic is flowing — the batcher owns it)."""
+        return self._predictors[bucket]
+
+    # -- lifecycle -----------------------------------------------------
+    def warmup(self):
+        """Compile (or AOT-cache-deserialize) every bucket's inference
+        program before traffic. Buckets compile concurrently (XLA
+        compilation releases the GIL — same recipe as
+        ``BucketingModule.compile``), so a cold start costs roughly one
+        compile, not one per bucket. With ``MXNET_AOT_CACHE=1`` the
+        compiled executables persist, so the NEXT server process warms
+        from disk without touching XLA. Returns {bucket: compiled kinds}."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        done = {}
+        with _tm.span("serving.warmup"):
+            items = list(self._predictors.items())
+            if len(items) > 1:
+                with ThreadPoolExecutor(
+                        max_workers=min(len(items),
+                                        os.cpu_count() or 1)) as pool:
+                    futs = {b: pool.submit(pred._exec.compile, ["forward"])
+                            for b, pred in items}
+                    done = {b: f.result() for b, f in futs.items()}
+            else:
+                for b, pred in items:
+                    done[b] = pred._exec.compile(["forward"])
+        self._warm = True
+        _tm.counter("serving.warmup_buckets").inc(len(done))
+        self.logger.info("serving: warmed buckets %s",
+                         list(self._predictors))
+        return done
+
+    def start(self):
+        """Begin accepting traffic (warmup first if not already warm);
+        starts the checkpoint watcher when configured."""
+        if self._closed:
+            raise ServerClosed("server already closed")
+        if not self._warm:
+            self.warmup()
+        self._batcher.start()
+        if (self.config.watch_dir and self.config.watch_period > 0
+                and self._watcher is None):
+            # _latest_seen was recorded when the weights were LOADED
+            # (__init__/reload), not re-read here: a checkpoint committed
+            # between load and start() must still hot-reload, and None
+            # (initial weights from elsewhere) makes the first poll adopt
+            # the watched directory's checkpoint
+            self._watcher = threading.Thread(
+                target=self._watch_loop, name="serving-watch", daemon=True)
+            self._watcher.start()
+        return self
+
+    def close(self, drain=True, timeout=30.0):
+        """Stop accepting requests; ``drain=True`` completes everything
+        already queued before returning (graceful shutdown)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._watch_stop.set()
+        self._batcher.stop(drain=drain, timeout=timeout)
+        if self._watcher is not None:
+            self._watcher.join(timeout=5.0)
+            self._watcher = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- request path --------------------------------------------------
+    def _coerce(self, inputs):
+        """Validate one request's inputs against the per-sample contract
+        and coerce to the BOUND dtypes (so stacking/padding is exact and
+        integer inputs stay integers)."""
+        if not isinstance(inputs, dict):
+            if len(self._input_names) != 1:
+                raise MXNetError(
+                    f"model has inputs {self._input_names}; pass a dict")
+            inputs = {self._input_names[0]: inputs}
+        out = {}
+        for name, shape in self._sample_shapes.items():
+            if name not in inputs:
+                raise MXNetError(f"missing input {name!r}")
+            arr = np.asarray(inputs[name])
+            if tuple(arr.shape) != shape:
+                raise MXNetError(
+                    f"input {name!r}: per-sample shape {shape} expected, "
+                    f"got {tuple(arr.shape)}")
+            out[name] = np.ascontiguousarray(
+                arr, dtype=self._input_dtypes[name])
+        unknown = set(inputs) - set(self._sample_shapes)
+        if unknown:
+            raise MXNetError(f"unknown inputs {sorted(unknown)}")
+        return out
+
+    def submit(self, inputs, deadline_ms=None):
+        """Admit one request; returns a ``Future`` resolving to the list
+        of output arrays (one per model output, per-sample shape).
+        Sheds with ``ServerOverloaded`` when the queue is full."""
+        if self._closed:
+            raise ServerClosed("server closed")
+        coerced = self._coerce(inputs)
+        if deadline_ms is None and self.config.deadline > 0:
+            deadline_ms = self.config.deadline * 1e3
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms else None)
+        return self._batcher.submit(coerced, deadline=deadline)
+
+    def predict(self, inputs, timeout=None, deadline_ms=None):
+        """Synchronous :meth:`submit` — blocks for the outputs."""
+        return self.submit(inputs, deadline_ms=deadline_ms).result(timeout)
+
+    def _infer(self, bucket, stacked, n_valid):
+        """Batcher runner: one atomic forward on the bucket's predictor.
+        Returns outputs batch-major (numpy); rows >= n_valid are padding
+        the batcher discards."""
+        return self._predictors[bucket].run(**stacked)
+
+    # -- hot reload ----------------------------------------------------
+    def reload(self, source=None):
+        """Swap weights from ``source`` (checkpoint dir / ``.params``
+        file / blob / dict; None = the configured ``watch_dir``) without
+        dropping in-flight requests.
+
+        The swap happens under the batcher's run lock, i.e. strictly
+        BETWEEN batches: every response is computed against exactly one
+        weight version. Queued requests simply run against the new
+        weights when their batch dispatches."""
+        if source is None:
+            source = self.config.watch_dir
+        if source is None:
+            raise MXNetError("reload: no source and no watch_dir")
+        with _tm.span("serving.reload_apply"):
+            arg_params, aux_params, loaded_commit = _load_params(source)
+            if self._fold_active:
+                from ..contrib import fold_batchnorm
+
+                # deliberately NOT try/except: serving unfolded weights on
+                # a folded graph would silently return garbage — a bad
+                # reload must fail loudly and keep the old weights live
+                _, arg_params = fold_batchnorm(
+                    self._symbol_unfolded(), arg_params, aux_params)
+                # the fold keeps the folded-out BNs' gamma/beta (and the
+                # raw conv weights' pre-fold values) in its output dict;
+                # the folded graph has no such arguments, so drop them
+                # before the strict set_params swap
+                bound = set(self._symbol.list_arguments())
+                arg_params = {k: v for k, v in arg_params.items()
+                              if k in bound}
+            # one host→device transfer; the per-bucket swaps below are
+            # then device-side copies into the shared bound arrays
+            arg_params = self._to_ctx(arg_params)
+            aux_params = self._to_ctx(aux_params)
+            with self._batcher.run_lock:
+                # every bucket binds the SAME device arrays (weights were
+                # moved to ctx once at construction, pinned by
+                # test_buckets_share_device_weights), so one set_params
+                # swaps the values every bucket sees; the other buckets
+                # only need their param STORES synced for a later reshape
+                # re-bind — not len(buckets)-1 more full device copies
+                # while the run lock is blocking traffic
+                first, *rest = self._predictors.values()
+                first.set_params(arg_params, aux_params,
+                                 allow_missing=False)
+                for pred in rest:
+                    with pred._lock:
+                        for name in arg_params:
+                            if name in first.arg_params:
+                                pred.arg_params[name] = \
+                                    first.arg_params[name]
+                        for name in aux_params:
+                            if name in first.aux_params:
+                                pred.aux_params[name] = \
+                                    first.aux_params[name]
+                        pred._partial_outs = None
+                self.version += 1
+                if loaded_commit is not None and self._is_watch_dir(source):
+                    self._latest_seen = loaded_commit
+        _tm.counter("serving.reload").inc()
+        self.logger.info("serving: reloaded weights from %s (version %d)",
+                         source, self.version)
+        return self.version
+
+    def _symbol_unfolded(self):
+        # _fold replaced self._symbol with the folded graph at
+        # construction; folding new params must start from the ORIGINAL
+        # graph. fold_batchnorm is deterministic, so re-deriving it from
+        # the stored original keeps reload-time folds bitwise consistent.
+        return self._orig_symbol
+
+    def _read_latest(self):
+        if not self.config.watch_dir:
+            return None
+        try:
+            with open(os.path.join(self.config.watch_dir, "LATEST")) as f:
+                return f.read().strip() or None
+        except OSError:
+            return None
+
+    def _watch_loop(self):
+        while not self._watch_stop.wait(self.config.watch_period):
+            latest = self._read_latest()
+            if latest is None or latest == self._latest_seen:
+                continue
+            try:
+                self.reload(self.config.watch_dir)
+                # reload recorded the commit it loaded; additionally mark
+                # the polled pointer consumed — when the newest commit is
+                # corrupt, load_latest falls back to an older one, and
+                # without this the watcher would re-reload every poll
+                self._latest_seen = latest
+            except Exception:
+                _tm.counter("serving.reload_error").inc()
+                self.logger.exception(
+                    "serving: hot reload from %s failed; serving previous "
+                    "weights", self.config.watch_dir)
+
+    # -- introspection -------------------------------------------------
+    def stats(self):
+        """Health/inspection payload (the ``/healthz`` body)."""
+        return {
+            "status": "draining" if self._closed else (
+                "ok" if self._batcher.running else "warming"),
+            "buckets": list(self.config.buckets),
+            "queue_depth": len(self._batcher._queue),
+            "queue_limit": self.config.queue_depth,
+            "max_delay_ms": self.config.max_delay * 1e3,
+            "version": self.version,
+            "latency": self.latency.snapshot(),
+            "inputs": {n: list(s) for n, s in self._sample_shapes.items()},
+        }

@@ -30,8 +30,10 @@ counters, deserialize/serialize/compile spans — mxnet_tpu.aot),
 ``bucketing.switch``/``bucketing.compile_on_switch`` (bucket-miss
 recompiles), the ``fit.train_window_k`` gauge (adaptive window depth),
 ``kvstore.*``/``kvstore_async.*`` (push/pull/bytes/barrier),
-``metric.*`` (device vs numpy-fallback accumulation, drain syncs) and
-``ndarray.asnumpy``/``ndarray.wait_to_read`` (every host-blocking sync).
+``metric.*`` (device vs numpy-fallback accumulation, drain syncs),
+``ndarray.asnumpy``/``ndarray.wait_to_read`` (every host-blocking sync),
+and ``serving.*`` (request admission/shed, batch composition,
+queue-wait/infer/latency, hot reloads — mxnet_tpu.serving).
 """
 
 from __future__ import annotations

@@ -1,0 +1,234 @@
+"""Predictor coverage (reference c_predict_api semantics): creation from
+JSON/file/blob, partial-output predictors, partial_forward, reshape
+validation, and the input-dtype contract (integer inputs bind and load as
+integers — no silent float32 round-trip)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.predictor import (Predictor, create_predictor,
+                                 create_predictor_partial, load_ndlist)
+
+
+@pytest.fixture(scope="module")
+def mlp_model(tmp_path_factory):
+    """(symbol, params, json_str, symbol_file, params_file, blob_bytes)."""
+    sym = models.mlp(num_classes=4)
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 6), softmax_label=(1,))
+    rng = np.random.RandomState(0)
+    params = {}
+    for n, s in zip(sym.list_arguments(), arg_shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        params[n] = mx.nd.array(rng.randn(*s).astype(np.float32))
+    prefix = str(tmp_path_factory.mktemp("predictor") / "mlp")
+    mx.model.save_checkpoint(prefix, 0, sym, params, {})
+    sym_file = f"{prefix}-symbol.json"
+    params_file = f"{prefix}-0000.params"
+    with open(params_file, "rb") as f:
+        blob = f.read()
+    return sym, params, sym.tojson(), sym_file, params_file, blob
+
+
+def _x(n=1, seed=3):
+    return np.random.RandomState(seed).uniform(-1, 1, (n, 6)) \
+        .astype(np.float32)
+
+
+def test_create_from_json_file_and_blob(mlp_model):
+    sym, params, json_str, sym_file, params_file, blob = mlp_model
+    x = _x()
+    outs = []
+    for pred in (
+        Predictor(json_str, params_file, {"data": (1, 6)}),
+        Predictor(sym_file, params_file, {"data": (1, 6)}),
+        Predictor(sym, {f"arg:{k}": v for k, v in params.items()},
+                  {"data": (1, 6)}),
+        create_predictor(json_str, blob, {"data": (1, 6)}),  # C-shim path
+    ):
+        pred.set_input("data", x)
+        pred.forward()
+        assert pred.num_outputs == 1
+        assert pred.get_output_shape(0) == (1, 4)
+        outs.append(pred.get_output(0))
+    for o in outs[1:]:  # same weights through every load path → same bytes
+        assert o.tobytes() == outs[0].tobytes()
+    s = np.asarray(outs[0]).sum(axis=1)
+    np.testing.assert_allclose(s, 1.0, rtol=1e-5)  # softmax rows
+
+
+def test_forward_kwargs_and_bytes_roundtrip(mlp_model):
+    _, _, json_str, _, params_file, _ = mlp_model
+    pred = Predictor(json_str, params_file, {"data": (2, 6)})
+    x = _x(2)
+    pred.forward(data=x)
+    a = pred.get_output(0)
+    pred.set_input_bytes("data", x.tobytes())
+    pred.forward()
+    assert pred.get_output(0).tobytes() == a.tobytes()
+    assert len(pred.get_output_bytes(0)) == 2 * 4 * 4  # f32 (2,4)
+
+
+def test_create_predictor_partial(mlp_model):
+    _, _, json_str, _, params_file, blob = mlp_model
+    # both the node name and the _output convention resolve
+    for key in ("fc1", "fc1_output"):
+        pred = create_predictor_partial(
+            json_str, blob, {"data": (1, 6)}, [key])
+        pred.forward(data=_x())
+        assert pred.get_output_shape(0) == (1, 128)
+    with pytest.raises(MXNetError):
+        create_predictor_partial(
+            json_str, blob, {"data": (1, 6)}, ["nonexistent_layer"])
+
+
+def test_partial_forward(mlp_model):
+    _, _, json_str, _, params_file, _ = mlp_model
+    pred = Predictor(json_str, params_file, {"data": (1, 6)})
+    x = _x()
+    pred.forward(data=x)
+    full = pred.get_output(0)
+    total = sum(1 for nd in pred._exec.graph.topo if not nd.is_variable)
+    remaining = pred.partial_forward(0)  # just the first op node
+    assert remaining == total - 1
+    remaining = pred.partial_forward(total - 1)  # the whole graph
+    assert remaining == 0
+    assert pred.get_output(0).tobytes() == full.tobytes()
+    # next full forward clears the partial view
+    pred.forward(data=x)
+    assert pred.get_output(0).tobytes() == full.tobytes()
+
+
+def test_reshape_rebinds_and_validates(mlp_model):
+    _, _, json_str, _, params_file, _ = mlp_model
+    pred = Predictor(json_str, params_file, {"data": (1, 6)})
+    x1 = _x()
+    pred.forward(data=x1)
+    ref = pred.get_output(0)
+    pred.reshape({"data": (3, 6)})
+    x3 = np.concatenate([x1, _x(2, seed=5)])
+    pred.forward(data=x3)
+    assert pred.get_output_shape(0) == (3, 4)
+    np.testing.assert_allclose(pred.get_output(0)[0], ref[0], rtol=1e-5,
+                               atol=1e-12)
+
+    # unknown input name: a clear error, not a silently stale binding
+    with pytest.raises(MXNetError, match="not_an_input"):
+        pred.reshape({"not_an_input": (1, 6)})
+    # the failed reshape left the predictor usable at its old shape
+    pred.forward(data=x3)
+    assert pred.get_output_shape(0) == (3, 4)
+
+
+def test_unknown_input_rejected_at_create(mlp_model):
+    _, _, json_str, _, params_file, _ = mlp_model
+    with pytest.raises(MXNetError, match="bogus"):
+        Predictor(json_str, params_file, {"bogus": (1, 6)})
+
+
+def test_int_inputs_preserved_exactly():
+    """Integer inputs bound as integers survive set_input/set_input_bytes
+    exactly. 2**24 + 1 is unrepresentable in float32 — the old forced
+    np.float32 coercion rounded it to 2**24."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.Flatten(data, name="flat")  # dtype-preserving graph
+    pred = Predictor(out, {}, {"data": (1, 3)},
+                     input_types={"data": "int32"})
+    big = np.array([[2**24 + 1, 1, -7]], dtype=np.int64)
+    pred.set_input("data", big)
+    pred.forward()
+    got = pred.get_output(0)
+    assert got.dtype == np.int32
+    assert got.tolist() == [[2**24 + 1, 1, -7]]
+
+    # raw-byte ABI path reads the BOUND dtype, not forced float32
+    pred.set_input_bytes(
+        "data", np.array([[2**24 + 3, 0, 5]], np.int32).tobytes())
+    pred.forward()
+    assert pred.get_output(0).tolist() == [[2**24 + 3, 0, 5]]
+
+    # unknown name fails with the framework error, not a bare KeyError
+    with pytest.raises(MXNetError, match="not an input"):
+        pred.set_input_bytes("bogus", b"\x00" * 12)
+
+
+def test_float_inputs_still_coerce():
+    """Float-bound inputs keep accepting python lists / int arrays
+    (legacy behaviour: everything funnels to the bound float32)."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.Flatten(data, name="flat")
+    pred = Predictor(out, {}, {"data": (1, 2)})
+    pred.set_input("data", [[1, 2]])
+    pred.forward()
+    got = pred.get_output(0)
+    assert got.dtype == np.float32
+    assert got.tolist() == [[1.0, 2.0]]
+
+
+def test_input_types_validation():
+    data = mx.sym.Variable("data")
+    out = mx.sym.Flatten(data, name="flat")
+    with pytest.raises(MXNetError, match="not inputs"):
+        Predictor(out, {}, {"data": (1, 2)},
+                  input_types={"wrong": "int32"})
+
+
+def test_set_params_swaps_weights(mlp_model):
+    sym, params, json_str, _, params_file, _ = mlp_model
+    pred = Predictor(json_str, params_file, {"data": (1, 6)})
+    x = _x()
+    pred.forward(data=x)
+    before = pred.get_output(0)
+    scaled = {k: (v * 2.0) for k, v in params.items()}
+    pred.set_params(scaled)
+    pred.forward(data=x)
+    after = pred.get_output(0)
+    assert before.tobytes() != after.tobytes()
+    # matches a predictor constructed with the new weights
+    ref = Predictor(sym, {f"arg:{k}": v for k, v in scaled.items()},
+                    {"data": (1, 6)})
+    ref.forward(data=x)
+    assert after.tobytes() == ref.get_output(0).tobytes()
+
+    with pytest.raises(MXNetError, match="missing"):
+        pred.set_params({"fc1_weight": scaled["fc1_weight"]})
+    with pytest.raises(MXNetError, match="shape mismatch"):
+        pred.set_params({k: mx.nd.zeros((1, 1)) for k in scaled})
+
+
+def test_set_params_failure_is_atomic(mlp_model):
+    """A set_params that fails partway (shape mismatch on a LATER key)
+    must leave the bound net fully on the old weights — never a
+    half-swapped mix of versions (the serving hot-reload contract)."""
+    sym, params, json_str, _, params_file, _ = mlp_model
+    pred = Predictor(json_str, params_file, {"data": (1, 6)})
+    x = _x()
+    pred.forward(data=x)
+    before = pred.get_output(0)
+    bad = {k: (v * 3.0) for k, v in params.items()}
+    # corrupt the LAST key in iteration order so earlier entries would
+    # already have been copied by a non-atomic swap
+    last = list(bad)[-1]
+    bad[last] = mx.nd.zeros((2, 2))
+    with pytest.raises(MXNetError, match="shape mismatch"):
+        pred.set_params(bad)
+    pred.forward(data=x)
+    assert pred.get_output(0).tobytes() == before.tobytes(), (
+        "failed set_params left a half-swapped weight mix")
+    # an unknown argument name fails the same way, weights untouched
+    with pytest.raises(MXNetError, match="not a .*bound argument"):
+        pred.set_params(dict({k: v * 3.0 for k, v in params.items()},
+                             bogus_weight=mx.nd.zeros((1,))))
+    pred.forward(data=x)
+    assert pred.get_output(0).tobytes() == before.tobytes()
+
+
+def test_load_ndlist(mlp_model):
+    _, params, _, _, _, blob = mlp_model
+    items = load_ndlist(blob)
+    assert len(items) == len(params)
+    assert all(k.startswith("arg:") for k, _ in items)
+    assert all(v.dtype == np.float32 for _, v in items)

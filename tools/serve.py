@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Serve a model over HTTP: dynamic batching + bucketed AOT inference.
+
+Stdlib-only CLI over :mod:`mxnet_tpu.serving`. Examples::
+
+    # from a save_checkpoint prefix (prefix-symbol.json + prefix-0000.params)
+    python tools/serve.py --prefix model/resnet50 --epoch 0 \\
+        --input data:3,224,224 --buckets 1,4,16,64 --port 8080
+
+    # from a PR-4 checkpoint directory, hot-reloading as training commits
+    python tools/serve.py --checkpoint-dir ckpts --symbol net-symbol.json \\
+        --input data:3,224,224 --watch 5
+
+    # client
+    curl -s localhost:8080/healthz
+    curl -s -X POST localhost:8080/predict \\
+        -H 'Content-Type: application/json' \\
+        -d '{"inputs": {"data": [[...]]}}'
+    curl -s localhost:8080/metrics   # Prometheus text
+
+Pre-compiles every bucket before binding the port (zero request-path
+compiles; set MXNET_AOT_CACHE=1 to persist executables so the NEXT serve
+process warms from disk). SIGINT drains gracefully: queued requests
+complete, new ones are refused.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def _parse_input(spec):
+    """'name:3,224,224' -> (name, (3, 224, 224))."""
+    name, _, dims = spec.partition(":")
+    if not dims:
+        raise argparse.ArgumentTypeError(
+            f"--input wants name:d0,d1,... got {spec!r}")
+    return name, tuple(int(d) for d in dims.split(","))
+
+
+def _parse_type(spec):
+    name, _, dt = spec.partition(":")
+    if not dt:
+        raise argparse.ArgumentTypeError(
+            f"--input-type wants name:dtype, got {spec!r}")
+    return name, dt
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    src = ap.add_argument_group("model source")
+    src.add_argument("--prefix",
+                     help="save_checkpoint prefix (reads "
+                          "PREFIX-symbol.json + PREFIX-EPOCH.params)")
+    src.add_argument("--epoch", type=int, default=0)
+    src.add_argument("--symbol", help="symbol .json path")
+    src.add_argument("--params", help=".params file")
+    src.add_argument("--checkpoint-dir",
+                     help="PR-4 checkpoint directory: initial weights come "
+                          "from its latest valid commit; with --watch it "
+                          "is also polled for hot reload")
+    ap.add_argument("--input", action="append", type=_parse_input,
+                    required=True, metavar="NAME:D0,D1,...",
+                    help="per-SAMPLE input shape (no batch dim); repeat "
+                         "for multi-input models")
+    ap.add_argument("--input-type", action="append", type=_parse_type,
+                    default=[], metavar="NAME:DTYPE",
+                    help="input dtype (default float32; token ids should "
+                         "be int32)")
+    ap.add_argument("--buckets", default=None,
+                    help="batch-size buckets (default "
+                         "$MXNET_SERVING_BUCKETS)")
+    ap.add_argument("--max-delay-ms", type=float, default=None)
+    ap.add_argument("--queue-depth", type=int, default=None)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--watch", type=float, default=None,
+                    help="poll --checkpoint-dir every N seconds for new "
+                         "checkpoints (default $MXNET_SERVING_WATCH)")
+    ap.add_argument("--no-fold-bn", action="store_true",
+                    help="skip the inference BatchNorm fold")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--dev-type", default="cpu",
+                    choices=["cpu", "gpu", "tpu"])
+    ap.add_argument("--dev-id", type=int, default=0)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.prefix:
+        symbol = f"{args.prefix}-symbol.json"
+        params = f"{args.prefix}-{args.epoch:04d}.params"
+    elif args.checkpoint_dir:
+        params = args.checkpoint_dir
+        symbol = args.symbol or _latest_ckpt_symbol(args.checkpoint_dir)
+    elif args.symbol and args.params:
+        symbol, params = args.symbol, args.params
+    else:
+        ap.error("need --prefix, --checkpoint-dir, or --symbol + --params")
+
+    from mxnet_tpu.serving import ModelServer, ServingConfig, serve_http
+
+    config = ServingConfig(
+        buckets=args.buckets, max_delay_ms=args.max_delay_ms,
+        queue_depth=args.queue_depth, deadline_ms=args.deadline_ms,
+        watch_dir=args.checkpoint_dir, watch_period=args.watch,
+        fold_bn=not args.no_fold_bn)
+    server = ModelServer(
+        symbol, params, dict(args.input), config=config,
+        dev_type=args.dev_type, dev_id=args.dev_id,
+        input_types=dict(args.input_type) or None)
+    serve_http(server, host=args.host, port=args.port)
+
+
+def _latest_ckpt_symbol(ckpt_dir):
+    """symbol.json inside the newest valid checkpoint commit."""
+    from mxnet_tpu.checkpoint import load_latest
+
+    loaded = load_latest(ckpt_dir)
+    if loaded is None:
+        sys.exit(f"no valid checkpoint under {ckpt_dir!r}")
+    path = os.path.join(loaded.path, "symbol.json")
+    if not os.path.exists(path):
+        sys.exit(f"{loaded.path} has no symbol.json; pass --symbol")
+    return path
+
+
+if __name__ == "__main__":
+    main()
