@@ -31,7 +31,10 @@ the standard JAX production answer, in three coordinated pieces:
    dispatch-vs-residual ratio into a window depth. Dispatch-bound loops
    (tunneled runtimes where every execute costs a serialized round trip)
    get deep windows; device/data-bound loops stay at K=1, where a window
-   buys nothing and costs metric granularity.
+   buys nothing and costs metric granularity. The same profile co-tunes
+   the pipelined *dispatch depth* (``MXNET_DISPATCH_DEPTH``,
+   :func:`choose_dispatch_depth`): how many windows ``Module.fit`` keeps
+   in flight before fencing on the oldest boundary.
 
 Telemetry: counters ``aot.cache_hit`` / ``aot.cache_miss`` /
 ``aot.cache_store`` / ``aot.deserialize_error`` / ``aot.serialize_unsupported``
@@ -56,6 +59,7 @@ _SUFFIX = ".aotx"
 __all__ = [
     "AOTProgram", "cache_enabled", "cache_dir", "digest", "load", "store",
     "supports_serialization", "choose_train_window", "train_window_setting",
+    "choose_dispatch_depth", "dispatch_depth_setting",
     "TrainWindowScheduler",
 ]
 
@@ -310,6 +314,38 @@ def train_window_setting():
     return k if k > 1 else None
 
 
+def dispatch_depth_setting():
+    """Parsed ``MXNET_DISPATCH_DEPTH``: 'auto' or an int >= 1."""
+    raw = str(_env.get("MXNET_DISPATCH_DEPTH")).strip().lower()
+    if raw in ("", "auto"):
+        return "auto"
+    try:
+        d = int(raw)
+    except ValueError:
+        return "auto"
+    return max(1, d)
+
+
+def choose_dispatch_depth(dispatch_us, residual_us, max_depth=4):
+    """Windows to keep in flight, from a measured per-step host profile.
+
+    Depth 2 (double buffering) is the baseline pipeline answer: while
+    window N executes on device, the host assembles and dispatches N+1,
+    so the device never idles across a window boundary. A deeper queue
+    only helps when the host's per-step work is dominated by dispatch
+    itself (``dispatch_us`` > the residual — a serialized tunnel round
+    trip): bursts of host time can then bubble a 2-deep queue, and one
+    extra window of slack absorbs them. Depth never exceeds
+    ``max_depth`` — every in-flight window pins K staged batches of
+    device memory.
+    """
+    host = max(dispatch_us, 0.0) + max(residual_us, 0.0)
+    if host <= 0:
+        return 2
+    share = max(dispatch_us, 0.0) / host
+    return max(2, min(int(max_depth), 2 + int(share > 0.5)))
+
+
 def choose_train_window(dispatch_us, residual_us, max_k=32,
                         overhead_budget=0.1):
     """Window depth K from a measured per-step host profile.
@@ -344,13 +380,22 @@ class TrainWindowScheduler:
     matching ``train_window`` semantics). A telemetry ``reset()`` during
     the probe (bench.py's compile-epoch reset) restarts it. The decision
     is published on the ``fit.train_window_k`` gauge.
+
+    The scheduler also owns the pipelined-dispatch depth (how many
+    windows fit keeps in flight, ``MXNET_DISPATCH_DEPTH``): auto co-tunes
+    (K, depth) from the same dispatch-vs-residual profile — depth >= 2
+    whenever windows engage (:func:`choose_dispatch_depth`), and K then
+    relaxes because the in-flight overlap already hides the per-window
+    round trip. ``cap_depth`` lets fit force depth 1 for policies whose
+    boundaries must fence (see docs/architecture.md taxonomy); the
+    ``fit.dispatch_depth`` gauge reports the operative value either way.
     """
 
     SKIP_BATCHES = 2
     PROBE_BATCHES = 8
     _PHASES = ("fit.dispatch", "fit.data_wait", "fit.metric", "fit.callback")
 
-    def __init__(self, setting, max_k=32):
+    def __init__(self, setting, max_k=32, depth_setting=None):
         self.max_k = max_k
         self.auto = setting == "auto"
         self.k = 1 if self.auto else int(setting)
@@ -358,7 +403,12 @@ class TrainWindowScheduler:
         self._batches = 0
         self._skipped = not self.auto
         self._base = {}
+        self._depth_setting = (dispatch_depth_setting()
+                               if depth_setting is None else depth_setting)
+        self._depth_cap_reason = None
+        self.depth = self._resolve_depth(None, None)
         _tm.gauge("fit.train_window_k").set(self.k)
+        _tm.gauge("fit.dispatch_depth").set(self.depth)
 
     @staticmethod
     def from_env(module, monitor=None):
@@ -371,6 +421,43 @@ class TrainWindowScheduler:
         if not callable(getattr(module, "train_window", None)):
             return None
         return TrainWindowScheduler(setting)
+
+    def _resolve_depth(self, dispatch_us, residual_us):
+        """Dispatch depth for the current K (+ optional measured profile).
+        Policy caps win, then K<=1 forces 1 (no windows means no pipeline,
+        whatever the env says — the per-batch loop pipelines through data
+        prefetch), then a fixed env setting, then auto: 2 as the
+        unprofiled window default, :func:`choose_dispatch_depth` once the
+        probe measured the dispatch-vs-residual split."""
+        if self._depth_cap_reason is not None:
+            return 1
+        if self.k <= 1:
+            # no windows, no pipeline — even a fixed MXNET_DISPATCH_DEPTH
+            # must not make the gauge claim a depth the per-batch loop
+            # cannot deliver (an operator would chase a phantom
+            # re-serialization)
+            return 1
+        if self._depth_setting != "auto":
+            return int(self._depth_setting)
+        if dispatch_us is None:
+            return 2
+        return choose_dispatch_depth(dispatch_us, residual_us)
+
+    def cap_depth(self, reason):
+        """Cap the dispatch depth at 1 — every window boundary fences —
+        and record why. Used by fit for policies whose boundary semantics
+        need a drained pipeline (MXNET_NONFINITE_GUARD=rollback); the
+        ``fit.dispatch_depth`` gauge reports the capped value so a trace
+        reader knows the depth is a policy decision, not a regression."""
+        self._depth_cap_reason = str(reason)
+        self.depth = 1
+        _tm.gauge("fit.dispatch_depth").set(1)
+        return self
+
+    @property
+    def depth_cap_reason(self):
+        """Why the depth is capped at 1, or None."""
+        return self._depth_cap_reason
 
     def _rebase(self):
         for name in self._PHASES:
@@ -386,6 +473,11 @@ class TrainWindowScheduler:
         """The window depth for the next dispatch (decides when the probe
         completes)."""
         if self._decided:
+            # re-assert the decision gauges: a telemetry reset (bench's
+            # compile-epoch reset) zeroes them, and the steady state is
+            # exactly what the post-reset snapshot must report
+            _tm.gauge("fit.train_window_k").set(self.k)
+            _tm.gauge("fit.dispatch_depth").set(self.depth)
             return self.k
         if not self._skipped:
             if self._batches >= self.SKIP_BATCHES:
@@ -413,7 +505,19 @@ class TrainWindowScheduler:
             return 1
         residual = sum(s for n, (_c, s) in deltas.items()
                        if n != "fit.dispatch")
-        self.k = choose_train_window(ds / dc, residual / dc, self.max_k)
+        dispatch_us, residual_us = ds / dc, residual / dc
+        self.k = choose_train_window(dispatch_us, residual_us, self.max_k)
+        self.depth = self._resolve_depth(dispatch_us, residual_us)
+        if self.k > 1 and self.depth > 1:
+            # co-tuning: with >= 2 windows in flight the per-window round
+            # trip overlaps device execution, so K only has to amortize
+            # the host's own dispatch work — the overhead budget relaxes
+            # by the depth factor and K shrinks (shorter windows = finer
+            # metric/callback granularity at the same throughput)
+            self.k = max(2, choose_train_window(
+                dispatch_us, residual_us, self.max_k,
+                overhead_budget=0.1 * self.depth))
         self._decided = True
         _tm.gauge("fit.train_window_k").set(self.k)
+        _tm.gauge("fit.dispatch_depth").set(self.depth)
         return self.k

@@ -124,6 +124,27 @@ _declare("MXNET_TRAIN_WINDOW", str, "",
          "dispatch-bound (tunneled) runtimes, K=1 when device/data-bound. "
          "Windows move lr-schedule and metric updates to window "
          "granularity. Empty (default) keeps the per-batch loop.")
+_declare("MXNET_DISPATCH_DEPTH", str, "",
+         "Training windows Module.fit keeps IN FLIGHT at once (pipelined "
+         "window dispatch): window N+1 is assembled and dispatched while "
+         "window N executes, and the host only fences (WindowBoundary."
+         "wait) when the in-flight count would exceed this depth. An "
+         "integer >= 1 fixes the depth (1 = the pre-pipelining serial "
+         "fence per window); empty/'auto' (default) lets the window "
+         "scheduler co-tune it with K from the measured dispatch-vs-"
+         "residual span ratio (aot.choose_dispatch_depth, >= 2 whenever "
+         "windows engage). The decision is published on the "
+         "fit.dispatch_depth gauge; policies that must fence every "
+         "boundary (MXNET_NONFINITE_GUARD=rollback) cap it at 1 and log "
+         "why. Each in-flight window holds K batches of staged inputs, so "
+         "device memory scales with depth x K x batch.")
+_declare("MXNET_PREFETCH_DEPTH", int, 0,
+         "Staging-queue depth (batches) of the DevicePrefetchIter wrapped "
+         "around Module.fit/score iterators. 0 (default) = auto: start at "
+         "2 and grow to cover dispatch_depth x K + 1 batches when "
+         "pipelined training windows engage (the pipeline is only as deep "
+         "as the data already staged). An explicit value is honored "
+         "as-is.")
 _declare("MXNET_NONFINITE_GUARD", str, "",
          "Non-finite-gradient sentinel for training updates: 'skip' folds "
          "a device-side all-finite reduction into the fused train step and "

@@ -496,6 +496,38 @@ class Executor:
         self._pack_install(small["grad"], self.grad_dict, grad_flat,
                            force=True)
 
+    def _mark_grads_unpublished(self):
+        """After a no-publish training window the gradient buffers were
+        dead-coded out of the program; the old handles would silently serve
+        a PREVIOUS step's values, so every wrt handle raises loudly until
+        the next publishing step overwrites it."""
+        for n in self._wrt_names:
+            h = self.grad_dict.get(n)
+            if h is None:
+                continue
+            # metadata WITHOUT materializing: a deleted (donated) jax array
+            # still exposes its aval shape, and packed-slice thunks carry
+            # shape on the callback — never resolve _data here, that would
+            # slice the pack (per param, per window) just to throw it away
+            old = h._d
+            shape = (tuple(old.shape) if old is not None
+                     else getattr(h._lazy, "shape", None))
+
+            def thunk(n=n):
+                raise MXNetError(
+                    f"gradient '{n}' was not published: the last training "
+                    "window ran with publish_grads=False (pipelined "
+                    "dispatch elides the per-window f32 gradient "
+                    "publication). Run train_window(..., "
+                    "publish_grads=True) or a single step to read "
+                    "per-step gradients.")
+
+            if shape is not None:
+                thunk.shape = shape
+                thunk.dtype = np.float32
+            h._d = None  # the stale pre-window value must never be served
+            h._set_lazy(thunk)
+
     @staticmethod
     def _pack_clean(pack, handles):
         """True when no packed handle was written since the last install."""
@@ -680,7 +712,7 @@ class Executor:
         if self._in_shardings or self._node2dev or self._naive:
             return None
         (update_names, cache_token, with_hg, state_td, has_handles,
-         sched_mesh, n_steps, stack_names, guard_on) = plan_key
+         sched_mesh, n_steps, stack_names, guard_on, publish) = plan_key
         if sched_mesh is not None:
             return None
         opts = _tpu_compiler_options(self._ctx)
@@ -688,7 +720,7 @@ class Executor:
         return _aot.digest(
             "fused", self._sym_sha(), self._jit_signature(),
             (update_names, cache_token, with_hg, repr(state_td),
-             has_handles, n_steps, stack_names, guard_on),
+             has_handles, n_steps, stack_names, guard_on, publish),
             auto_layout, self.graph.remat, dev.platform,
             getattr(dev, "device_kind", ""),
             tuple(sorted(opts.items())) if opts else (),
@@ -1177,7 +1209,8 @@ class Executor:
         self._fresh = True
 
     def fused_train_update(self, update_names, apply_fn, states, lrs, wds, ts,
-                           cache_token, n_steps=1, data_stacks=None):
+                           cache_token, n_steps=1, data_stacks=None,
+                           publish_grads=True):
         """Forward + backward + optimizer update as ONE donated XLA program.
 
         The TPU answer to the reference's fused update kernels
@@ -1221,6 +1254,16 @@ class Executor:
         iteration ``i`` then trains on slice ``i`` (real epoch windows). The
         window requires plain ``write`` gradients (no ``add`` accumulation
         carry-in) and no explicit head gradients.
+
+        ``publish_grads=False`` (windows only) drops the boundary gradient
+        publication from the program's return contract: the final unrolled
+        step no longer materialises the f32 ``grad_map``/``grad_flat``
+        tensors (XLA dead-codes the casts and the concatenation — for a
+        ResNet-scale graph that is a full parameter-sized f32 write per
+        window spent on values nobody reads in a pipelined fit loop).
+        Outputs and aux states are still published; reading ``grad_dict``
+        after a no-publish window raises MXNetError until the next
+        publishing step runs.
         """
         import jax
 
@@ -1324,9 +1367,12 @@ class Executor:
         # tiny donated int32 buffer read back only at sync points (epoch
         # boundaries), so the guard adds zero per-batch host syncs
         guard_on = self._nonfinite_guard_on()
+        # a single step's callers (update(), monitors, guard fallbacks) all
+        # read gradients — publication is only elidable at window depth
+        publish = bool(publish_grads) or n_steps <= 1
         plan_key = (tuple(update_names), cache_token, with_hg, state_td,
                     state_handles is not None, sched_mesh, n_steps,
-                    stack_names, guard_on)
+                    stack_names, guard_on, publish)
         plan = self._fused_plan.get(plan_key)
         if plan is not None:
             _tm.counter("executor.fused_plan_hit").inc()
@@ -1516,13 +1562,21 @@ class Executor:
                      hyper_f, guard_f) = _lax.fori_loop(
                         0, n_steps - 1, body, init)
                     # final step, unrolled: full output contract
-                    return _step(
+                    final = _step(
                         upd_f, argf_f,
                         sub_data(jnp.asarray(n_steps - 1, jnp.int32),
                                  other_vals),
                         aux_f, auxf_f, rng_f, heads, prev_grads, st_f,
                         stf_f, hyper_f, guard_f,
                     )
+                    if publish:
+                        return final
+                    # lazy boundary publication: dropping grad_map/grad_flat
+                    # from the return contract lets XLA dead-code the final
+                    # step's f32 gradient casts + concatenation — the whole
+                    # per-window publish cost a pipelined fit never reads
+                    (outs_f, aux_big_f, aux_flat_f, _gm, _gf, *rest) = final
+                    return (outs_f, aux_big_f, aux_flat_f, *rest)
 
                 from . import env as _env
 
@@ -1704,9 +1758,15 @@ class Executor:
                         conv.append(v)
                     call_args = jax.tree_util.tree_unflatten(td, conv)
                 dispatched = True
-                (outs, aux_upd, aux_flat_out, grad_map, grad_flat,
-                 new_params, arg_flat_out, new_leaves, st_flat_out,
-                 next_hyper, new_guard, next_step) = aot[0](*call_args)
+                if publish:
+                    (outs, aux_upd, aux_flat_out, grad_map, grad_flat,
+                     new_params, arg_flat_out, new_leaves, st_flat_out,
+                     next_hyper, new_guard, next_step) = aot[0](*call_args)
+                else:
+                    (outs, aux_upd, aux_flat_out,
+                     new_params, arg_flat_out, new_leaves, st_flat_out,
+                     next_hyper, new_guard, next_step) = aot[0](*call_args)
+                    grad_map, grad_flat = {}, None
         except Exception:
             # a failure AFTER dispatch leaves the donated pack flats
             # consumed: invalidate so packed reads fail LOUDLY (the thunks
@@ -1746,9 +1806,12 @@ class Executor:
         self._bwd_aux_flat = None
         self._set_outputs(outs)
         self._set_aux(aux_upd, snap=aux_snap, flat=aux_flat_out)
-        for nm, g in grad_map.items():
-            self.grad_dict[nm]._data = g
-        self._install_grad_flat(grad_flat)
+        if publish:
+            for nm, g in grad_map.items():
+                self.grad_dict[nm]._data = g
+            self._install_grad_flat(grad_flat)
+        else:
+            self._mark_grads_unpublished()
         for nm, w, old in zip(update_names, new_params, upd_vals):
             if w is None:
                 continue  # packed: carried by arg_flat_out below

@@ -608,6 +608,23 @@ class DevicePrefetchIter(DataIter):
         )
         self._thread.start()
 
+    def set_depth(self, depth):
+        """Grow (or shrink) the staging-queue depth at runtime.
+
+        Pipelined window dispatch needs ``dispatch_depth x K`` batches
+        staged ahead — the pipeline is only as deep as the data already on
+        device — and fit learns K after the iterator is built, so the
+        queue bound is adjusted in place. The producer re-reads
+        ``Queue.maxsize`` under the queue mutex on every blocked put
+        (its 50 ms put timeout), so a live thread adopts the new bound
+        without a restart; shrinking takes effect as the consumer drains.
+        """
+        self.depth = max(1, int(depth))
+        q = self._queue
+        if q is not None:
+            q.maxsize = self.depth
+        return self.depth
+
     def _worker(self, q, abort):
         while not abort.is_set():
             try:

@@ -314,7 +314,8 @@ class DataParallelExecutorGroup:
     def has_pending_backward(self):
         return getattr(self._exec, "_bwd_scheduled", False)
 
-    def update_fused(self, optimizer, updater, n_steps=1, data_stacks=None):
+    def update_fused(self, optimizer, updater, n_steps=1, data_stacks=None,
+                     publish_grads=True):
         """Apply the optimizer inside the executor's jitted train step.
 
         TPU replacement for the reference's per-parameter ``Updater`` loop
@@ -415,6 +416,7 @@ class DataParallelExecutorGroup:
                 (None, host["state_td"], nd_leaves),
                 lrs, wds, ts, cache_token=opt_token,
                 n_steps=n_steps, data_stacks=data_stacks,
+                publish_grads=publish_grads,
             )
         except Exception as e:
             # roll back the update counts so a retried/fallback update sees

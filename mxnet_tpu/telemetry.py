@@ -28,7 +28,9 @@ epoch loops), ``executor.jit_*``/``executor.fused_plan_*`` (compile cache),
 ``aot.*`` (persistent executable cache: cache_hit/cache_miss/cache_store
 counters, deserialize/serialize/compile spans — mxnet_tpu.aot),
 ``bucketing.switch``/``bucketing.compile_on_switch`` (bucket-miss
-recompiles), the ``fit.train_window_k`` gauge (adaptive window depth),
+recompiles), the ``fit.train_window_k``/``fit.dispatch_depth``/
+``fit.windows_in_flight`` gauges + ``fit.window``/``fit.window_wait``
+spans (adaptive windows and their pipelined dispatch),
 ``kvstore.*``/``kvstore_async.*`` (push/pull/bytes/barrier),
 ``metric.*`` (device vs numpy-fallback accumulation, drain syncs),
 ``ndarray.asnumpy``/``ndarray.wait_to_read`` (every host-blocking sync),

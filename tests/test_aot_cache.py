@@ -32,7 +32,6 @@ def _subprocess_env(cache_dir):
     clean = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
              if p and "axon" not in p]
     env["PYTHONPATH"] = os.pathsep.join([_ROOT] + clean)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["MXNET_AOT_CACHE"] = "1"
     env["MXNET_AOT_CACHE_DIR"] = str(cache_dir)
@@ -259,6 +258,70 @@ def test_scheduler_fixed_setting_and_env_parse(monkeypatch):
     for off in ("", "0", "1", "none", "garbage"):
         monkeypatch.setenv("MXNET_TRAIN_WINDOW", off)
         assert aot.train_window_setting() is None
+
+
+def test_choose_dispatch_depth_profiles():
+    # double buffering is the baseline whenever windows engage
+    assert aot.choose_dispatch_depth(500.0, 3000.0) == 2
+    # dispatch-dominated host loop (tunnel round trips): one extra window
+    # of slack absorbs host-time bursts
+    assert aot.choose_dispatch_depth(3000.0, 500.0) == 3
+    assert aot.choose_dispatch_depth(3000.0, 500.0, max_depth=2) == 2
+    # no profile at all: still double-buffer
+    assert aot.choose_dispatch_depth(0.0, 0.0) == 2
+
+
+def test_dispatch_depth_env_parse(monkeypatch):
+    monkeypatch.delenv("MXNET_DISPATCH_DEPTH", raising=False)
+    assert aot.dispatch_depth_setting() == "auto"
+    monkeypatch.setenv("MXNET_DISPATCH_DEPTH", "auto")
+    assert aot.dispatch_depth_setting() == "auto"
+    monkeypatch.setenv("MXNET_DISPATCH_DEPTH", "3")
+    assert aot.dispatch_depth_setting() == 3
+    monkeypatch.setenv("MXNET_DISPATCH_DEPTH", "0")
+    assert aot.dispatch_depth_setting() == 1  # floor: a depth must exist
+    monkeypatch.setenv("MXNET_DISPATCH_DEPTH", "junk")
+    assert aot.dispatch_depth_setting() == "auto"
+
+
+def test_scheduler_co_tunes_k_and_depth(monkeypatch):
+    """Auto scheduling resolves (K, depth) together from the probe: a
+    dispatch-bound profile gets deep-ish windows AND depth >= 2, with K
+    SMALLER than the unpipelined choice (the in-flight overlap already
+    hides the round trip); device-bound stays (1, 1). cap_depth forces a
+    fenced pipeline and says why."""
+    monkeypatch.delenv("MXNET_DISPATCH_DEPTH", raising=False)
+
+    def run(dispatch_us, data_wait_us):
+        tm.reset()
+        sched = aot.TrainWindowScheduler("auto")
+        for _i in range(sched.SKIP_BATCHES + sched.PROBE_BATCHES):
+            sched.next_k()
+            tm.histogram("fit.dispatch").observe(dispatch_us)
+            tm.histogram("fit.data_wait").observe(data_wait_us)
+            sched.observe(1)
+        return sched.next_k(), sched
+
+    k, sched = run(dispatch_us=3000, data_wait_us=300)
+    assert k >= 2 and sched.depth >= 2
+    assert tm.gauge("fit.dispatch_depth").value == sched.depth
+    assert k <= aot.choose_train_window(3000, 300)  # co-tuned K relaxes
+    k1, sched1 = run(dispatch_us=100, data_wait_us=40000)
+    assert k1 == 1 and sched1.depth == 1
+    # policy cap: depth 1, reason recorded, gauge says so
+    k2, sched2 = run(dispatch_us=3000, data_wait_us=300)
+    sched2.cap_depth("nonfinite-rollback")
+    assert sched2.depth == 1
+    assert sched2.depth_cap_reason == "nonfinite-rollback"
+    assert tm.gauge("fit.dispatch_depth").value == 1
+    # a fixed env depth is honored without a probe
+    monkeypatch.setenv("MXNET_DISPATCH_DEPTH", "3")
+    assert aot.TrainWindowScheduler(4).depth == 3
+    # ...but K=1 means no windows: a fixed depth must not make the gauge
+    # claim a pipeline the per-batch loop cannot deliver
+    k3, sched3 = run(dispatch_us=100, data_wait_us=40000)
+    assert k3 == 1 and sched3.depth == 1
+    assert tm.gauge("fit.dispatch_depth").value == 1
 
 
 def test_fit_with_fixed_window_matches_serial_trajectory(monkeypatch):

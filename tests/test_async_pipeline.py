@@ -374,6 +374,122 @@ def test_speedometer_device_pending_safe(caplog):
 
 
 # ---------------------------------------------------------------------------
+# pipelined window dispatch (ISSUE 6): >=2 windows in flight, lazy boundary
+# ---------------------------------------------------------------------------
+def _run_fit_windows(monkeypatch, nbatches, depth, k=2, batch=8,
+                     num_epoch=2, seed=11):
+    """fit with fused K-step windows at the given dispatch depth; returns
+    (module, sync-counter dict) — counters read AFTER the run."""
+    monkeypatch.setenv("MXNET_TRAIN_WINDOW", str(k))
+    monkeypatch.setenv("MXNET_DISPATCH_DEPTH", str(depth))
+    it = mx.io.NDArrayIter(
+        _FIT_X[:nbatches * batch], _FIT_Y[:nbatches * batch],
+        batch_size=batch, last_batch_handle="discard")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mx.random.seed(seed)
+    tm.reset()
+    mod.fit(it, eval_metric=mx.metric.Accuracy(), num_epoch=num_epoch,
+            optimizer_params={"learning_rate": 0.05})
+    return mod, {name: tm.counter(name).value for name in _SYNC_COUNTERS}
+
+
+def test_fit_pipelined_windows_zero_per_window_sync(monkeypatch):
+    """Steady-state fit with dispatch depth 2 must issue ZERO per-window
+    host syncs: doubling the window count must not move the sync counters
+    (which must be zero outright), while the depth telemetry proves >=2
+    windows were actually in flight."""
+    _, c_small = _run_fit_windows(monkeypatch, 4, depth=2)  # 2 win/epoch
+    small_windows = tm.histogram("fit.window").count
+    assert tm.gauge("fit.dispatch_depth").value == 2
+    assert tm.gauge("fit.windows_in_flight").max >= 2
+    _, c_large = _run_fit_windows(monkeypatch, 8, depth=2)  # 4 win/epoch
+    assert c_small == c_large, (
+        f"per-window host sync detected: 2 windows/epoch -> {c_small}, "
+        f"4 windows/epoch -> {c_large}")
+    assert c_large["ndarray.asnumpy"] == 0
+    assert c_large["ndarray.wait_to_read"] == 0
+    assert c_large["metric.numpy_fallback"] == 0
+    assert c_large["metric.drain_sync"] == 2  # one per epoch
+    # the pipeline instrumentation saw the run: every full window spanned,
+    # every boundary retired through the backpressure fence
+    assert small_windows == 2 * 2
+    assert tm.histogram("fit.window").count == 4 * 2
+    assert tm.histogram("fit.window_wait").count > 0
+    assert tm.gauge("fit.windows_in_flight").max >= 2
+    assert tm.gauge("fit.windows_in_flight").value == 0  # drained
+
+
+def test_fit_dispatch_depth_parity_bit_identical(monkeypatch):
+    """Pipelining is a host-scheduling change only: depth=2 must produce
+    BIT-identical parameters to depth=1 for a fixed RNG run (same fused
+    programs, same data order, same rng stream)."""
+    mod1, _ = _run_fit_windows(monkeypatch, 6, depth=1)
+    mod2, _ = _run_fit_windows(monkeypatch, 6, depth=2)
+    a1, x1 = mod1.get_params()
+    a2, x2 = mod2.get_params()
+    for k in a1:
+        np.testing.assert_array_equal(
+            a1[k].asnumpy(), a2[k].asnumpy(), err_msg=k)
+    for k in x1:
+        np.testing.assert_array_equal(
+            x1[k].asnumpy(), x2[k].asnumpy(), err_msg=k)
+
+
+def test_fit_window_metrics_match_per_batch_path(monkeypatch):
+    """The pipelined window loop's epoch metric (window-granular: last
+    batch of each window) must match an unpipelined window run — the
+    depth must not change WHAT the metric sees."""
+    monkeypatch.setenv("MXNET_TRAIN_WINDOW", "2")
+    monkeypatch.setenv("MXNET_DISPATCH_DEPTH", "2")
+    m = mx.metric.Accuracy()
+    it = mx.io.NDArrayIter(_FIT_X[:48], _FIT_Y[:48], batch_size=8,
+                           last_batch_handle="discard")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mx.random.seed(7)
+    mod.fit(it, eval_metric=m, num_epoch=1,
+            optimizer_params={"learning_rate": 0.05})
+    val2 = m.get()[1]
+    monkeypatch.setenv("MXNET_DISPATCH_DEPTH", "1")
+    m1 = mx.metric.Accuracy()
+    it.reset()
+    mod1 = mx.mod.Module(_mlp(), context=mx.cpu())
+    mx.random.seed(7)
+    mod1.fit(it, eval_metric=m1, num_epoch=1,
+             optimizer_params={"learning_rate": 0.05})
+    assert val2 == pytest.approx(m1.get()[1], abs=1e-9)
+
+
+def test_fit_rollback_guard_caps_dispatch_depth(monkeypatch):
+    """MXNET_NONFINITE_GUARD=rollback must fence every boundary: the
+    dispatch-depth gauge reports the policy cap at 1 and at most one
+    window is ever in flight."""
+    monkeypatch.setenv("MXNET_NONFINITE_GUARD", "rollback")
+    _run_fit_windows(monkeypatch, 6, depth=2)
+    assert tm.gauge("fit.dispatch_depth").value == 1
+    assert tm.gauge("fit.windows_in_flight").max <= 1
+
+
+def test_prefetch_queue_grows_to_cover_pipeline(monkeypatch):
+    """Auto prefetch depth must cover dispatch_depth x K batches (+1) once
+    windows engage — the pipeline is only as deep as the staged data."""
+    depths = []
+    orig = mx.io.DevicePrefetchIter.set_depth
+
+    def spy(self, depth):
+        depths.append(depth)
+        return orig(self, depth)
+
+    monkeypatch.setattr(mx.io.DevicePrefetchIter, "set_depth", spy)
+    _run_fit_windows(monkeypatch, 6, depth=2, k=3)
+    assert depths and max(depths) == 3 * 2 + 1
+    # an explicit MXNET_PREFETCH_DEPTH wins over auto sizing
+    monkeypatch.setenv("MXNET_PREFETCH_DEPTH", "4")
+    depths.clear()
+    _run_fit_windows(monkeypatch, 6, depth=2, k=3)
+    assert not depths
+
+
+# ---------------------------------------------------------------------------
 # kvstore create spellings (satellite)
 # ---------------------------------------------------------------------------
 def test_kvstore_create_reference_spellings():

@@ -32,6 +32,13 @@ def test_bench_cpu_smoke():
     # the non-finite guard's cost stays visible in every BENCH_*.json
     assert "nonfinite_guard_overhead" in rec
     assert rec["guard_on_img_per_sec"] > 0
+    # guard overhead pin, pipelining enabled (windows dispatch with lazy
+    # boundary publication): the chip bar is < 2% and is recorded by the
+    # BENCH trajectory; this tiny-model CPU smoke measures the same loop
+    # with +/-6% host noise (observed), so the pin here is the
+    # noise-tolerant band that still catches a structural regression — a
+    # guard that re-grew a per-batch sync or fence costs 2x, not 15%
+    assert rec["nonfinite_guard_overhead"] < 0.15, rec
 
 
 def test_bench_fit_mode_reaches_window_rate():
@@ -78,10 +85,13 @@ def test_bench_fit_mode_reaches_window_rate():
 
 
 def test_bench_fit_guard_on_keeps_no_sync_invariant():
-    """With MXNET_NONFINITE_GUARD=skip, the fit loop's steady-state
-    telemetry (embedded in the bench record) must show ZERO host-blocking
-    syncs — the guard's skip decision lives on device and never reads
-    back per batch."""
+    """With MXNET_NONFINITE_GUARD=skip AND pipelined window dispatch, the
+    fit loop's steady-state telemetry (embedded in the bench record) must
+    show ZERO host-blocking syncs — the guard's skip decision lives on
+    device and never reads back per batch — and the guard must NOT cap
+    the pipeline: dispatch depth stays >= 2 (the gauge) with >= 2 windows
+    actually observed in flight. Only the rollback/raise policies may
+    fence to depth 1 (documented boundary-fence taxonomy)."""
     env = dict(os.environ)
     clean = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
              if p and "axon" not in p]
@@ -94,6 +104,8 @@ def test_bench_fit_guard_on_keeps_no_sync_invariant():
     env["BENCH_MODE"] = "fit"
     env["BENCH_WARM_START"] = "0"
     env["MXNET_NONFINITE_GUARD"] = "skip"
+    env["MXNET_TRAIN_WINDOW"] = "2"
+    env["MXNET_DISPATCH_DEPTH"] = "2"
     r = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "bench.py")],
         capture_output=True, text=True, env=env, timeout=900, cwd=_ROOT,
@@ -105,6 +117,16 @@ def test_bench_fit_guard_on_keeps_no_sync_invariant():
     assert nd.get("wait_to_read", 0) == 0, rec["telemetry"]
     metric = rec["telemetry"].get("metric", {})
     assert metric.get("numpy_fallback", 0) == 0, rec["telemetry"]
+    # pipelined dispatch pins (cpu-smoke fit mode): configured depth on
+    # the gauge, achieved depth on the in-flight high-water mark, and the
+    # JSON tail fields the trajectory reads
+    fit = rec["telemetry"].get("fit", {})
+    assert fit.get("dispatch_depth", {}).get("value", 0) >= 2, rec
+    assert fit.get("windows_in_flight", {}).get("max", 0) >= 2, rec
+    assert fit.get("window", {}).get("count", 0) >= 2, rec
+    assert rec.get("dispatch_depth", 0) >= 2, rec
+    assert rec.get("train_window_k", 0) == 2, rec
+    assert 0 < rec.get("dispatch_span_share", 0) <= 1, rec
 
 
 def test_bench_serve_mode_beats_sequential_and_never_compiles():

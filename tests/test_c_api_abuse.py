@@ -25,10 +25,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.fixture(scope="module")
 def lib(tmp_path_factory):
     out_dir = str(tmp_path_factory.mktemp("amal_abuse"))
-    env = dict(os.environ)
-    # a leaked axon pool address makes any spawned jax-initialising child
-    # dial the pool and hang for the full timeout; always scrub it
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = dict(os.environ)  # axon boot vars already scrubbed by conftest
     r = subprocess.run(
         ["python", os.path.join(_ROOT, "tools", "amalgamation.py"),
          "--out-dir", out_dir],

@@ -195,10 +195,7 @@ def _mlp():
 @pytest.fixture(scope="module")
 def amalgamated(tmp_path_factory):
     out_dir = str(tmp_path_factory.mktemp("amal"))
-    env = dict(os.environ)
-    # a leaked axon pool address makes any spawned jax-initialising child
-    # dial the pool and hang for the full timeout; always scrub it
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = dict(os.environ)  # axon boot vars already scrubbed by conftest
     r = subprocess.run(
         ["python", os.path.join(_ROOT, "tools", "amalgamation.py"),
          "--out-dir", out_dir],
@@ -235,8 +232,6 @@ def test_c_introspection_tier(amalgamated, tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
-    # known 300s hang mode: the embedded interpreter dials the axon pool
-    env.pop("PALLAS_AXON_POOL_IPS", None)
     r = subprocess.run(
         [client, prefix + "-symbol.json", prefix + "-0000.params", resave],
         capture_output=True, text=True, env=env, timeout=300,
@@ -294,7 +289,6 @@ def test_cached_op_tier(tmp_path):
 
     out_dir = str(tmp_path / "amal")
     env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
     r = subprocess.run(
         ["python", os.path.join(_ROOT, "tools", "amalgamation.py"),
          "--out-dir", out_dir],

@@ -365,3 +365,67 @@ def test_window_checkpoint_resume_exact(tmp_path):
     m2.load_optimizer_states(prefix + "-0003.states")
     m2.train_window(bs[0], n_steps=2)
     _assert_params_equal(m1, m2)
+
+
+def test_window_publish_grads_false_same_params_grads_raise(monkeypatch):
+    """A no-publish window trains IDENTICALLY (the gradient publication is
+    output-only — dead-coding it cannot change the update math), returns a
+    WindowBoundary whose grads() raises, and leaves grad_dict raising
+    loudly instead of serving a stale step's values."""
+    bs = _batches(3, seed=5)
+    mx.random.seed(9)
+    m_pub = _module()
+    mx.random.seed(9)
+    m_lazy = _module()
+    spy = _WindowSpy(monkeypatch)
+    b_pub = m_pub.train_window(None, batches=bs)
+    b_lazy = m_lazy.train_window(None, batches=bs, publish_grads=False)
+    assert spy.calls == [3, 3], "a window fell back to serial dispatch"
+    _assert_params_equal(m_pub, m_lazy, rtol=0, atol=0)  # bitwise
+    np.testing.assert_array_equal(
+        b_pub.outputs[0].asnumpy(), b_lazy.outputs[0].asnumpy())
+    # published boundary serves gradients; lazy boundary refuses
+    assert "fc1_weight" in b_pub.grads()
+    with pytest.raises(mx.base.MXNetError, match="publish_grads"):
+        b_lazy.grads()
+    with pytest.raises(mx.base.MXNetError, match="not published"):
+        m_lazy._exec_group._exec.grad_dict["fc1_weight"].asnumpy()
+    # metadata stays queryable without materializing (fit's prepare path
+    # and shape introspection must not blow up on unpublished handles)
+    g = m_lazy._exec_group._exec.grad_dict["fc1_weight"]
+    assert g.shape == m_lazy._exec_group._exec.arg_dict["fc1_weight"].shape
+    # the next publishing step heals the handles
+    m_lazy.forward_backward(bs[0])
+    m_lazy.update()
+    assert np.isfinite(
+        m_lazy._exec_group._exec.grad_dict["fc1_weight"].asnumpy()).all()
+
+
+def test_window_boundary_wait_and_serial_fallback(monkeypatch):
+    """WindowBoundary.wait() retires the window (chainable), and the
+    serial fallback honors publish_grads both ways: True snapshots the
+    boundary gradients, False skips the per-window snapshot (the
+    pipelined fit loop would discard it) while grad_dict itself keeps
+    the serial loop's real values."""
+    bs = _batches(2, seed=8)
+    m = _module()
+    b = m.train_window(None, batches=bs, publish_grads=False)
+    assert b.wait() is b and b.n_steps == 2
+    # empty windows return no boundary
+    assert m.train_window(None, batches=[]) is None
+    # force the serial fallback (non-traceable optimizer)
+    m2 = _module()
+    m2._optimizer.jax_apply = None
+    spy = _WindowSpy(monkeypatch)
+    b2 = m2.train_window(None, batches=bs, publish_grads=False)
+    assert spy.calls == [], "serial fallback dispatched a fused window"
+    assert b2 is not None and b2.n_steps == 2
+    assert b2.wait() is b2
+    with pytest.raises(mx.base.MXNetError, match="publish_grads"):
+        b2.grads()
+    # the serial loop still leaves real values on the live handles
+    assert np.isfinite(
+        m2._exec_group._exec.grad_dict["fc1_weight"].asnumpy()).all()
+    # and the default (publish_grads=True) serves a snapshotted boundary
+    b3 = m2.train_window(None, batches=bs)
+    assert "fc1_weight" in b3.grads()
