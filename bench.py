@@ -63,14 +63,26 @@ gradient sentinel on (``MXNET_NONFINITE_GUARD=skip``) and reports
 
 ``BENCH_MODE=serve`` times the INFERENCE serving path:
 ``serving.ModelServer`` (dynamic batcher over per-bucket pre-compiled
-predictors) under ``BENCH_SERVE_CLIENTS`` synthetic concurrent client
+predictors, replicated across ``BENCH_SERVE_REPLICAS`` devices — 0 =
+auto) under ``BENCH_SERVE_CLIENTS`` synthetic concurrent client
 threads, reporting ``serving_throughput`` (img/s), request p50/p99
-latency (from the server's log-bucket histogram), and
+latency (from the server's log-bucket histogram),
 ``sequential_img_per_sec`` — the same model driven one request at a time
-through the batch-1 predictor. The batcher must beat sequential
+through the batch-1 predictor — plus ``replicas`` and
+``per_replica_batches`` (the replication scaling evidence). With > 1
+replica it also measures ``single_replica_img_per_sec`` under the same
+concurrent load (``replica_scaling`` = the replication win;
+``BENCH_SERVE_SCALING=0`` skips). The batcher must beat sequential
 batch-1 (the smoke pin in tests/test_bench_smoke.py), and the embedded
 telemetry snapshot must show ``executor.jit_compile == 0`` — the warmed
 request path never compiles.
+
+``BENCH_CHAOS=1`` adds the availability-under-chaos leg: one replica is
+killed (env fault injection) under concurrent traffic, then revived;
+the JSON tail reports ``availability`` (completed/total across
+pre/fault/recover phases — pinned >= 0.99 in the cpu smoke),
+``p99_during_fault_ms``, the failover count, and the killed replica's
+final state (probe-recovered or still open).
 """
 
 import json
@@ -254,6 +266,70 @@ def _random_inference_params(mx, sym, image):
     return params
 
 
+def _drive_serve_phase(server, samples, clients, per_client, phase):
+    """One concurrent-client phase against ``server``; returns
+    [(ok, latency_s)] per request (the chaos leg needs per-phase
+    availability and latency, not just aggregates)."""
+    import threading
+
+    results = []
+    lock = threading.Lock()
+
+    def client(cid):
+        for i in range(per_client):
+            tic = time.time()
+            try:
+                server.predict(samples[(cid + i) % len(samples)],
+                               timeout=120)
+                ok = True
+            except Exception:  # noqa: BLE001 — availability accounting
+                ok = False
+            with lock:
+                results.append((ok, time.time() - tic))
+
+    threads = [threading.Thread(target=client, args=(c,), name=f"{phase}{c}")
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def _run_serve_chaos(mx, server, samples, clients, per_client):
+    """BENCH_CHAOS=1: kill one replica under concurrent traffic (env
+    fault injection, runtime-toggled), then revive it — report
+    availability across pre/fault/recover phases and p99 DURING the
+    fault. The serving availability SLO, measured, not asserted."""
+    failover = mx.telemetry.counter("serving.replica.failover")
+    f0 = failover.value
+    pre = _drive_serve_phase(server, samples, clients, per_client, "pre")
+    os.environ["MXNET_FI_SERVE_RAISE_REPLICA"] = "0"
+    try:
+        fault = _drive_serve_phase(server, samples, clients, per_client,
+                                   "fault")
+    finally:
+        os.environ.pop("MXNET_FI_SERVE_RAISE_REPLICA", None)
+    time.sleep(0.3)  # half-open probe backoff before the recovery phase
+    recover = _drive_serve_phase(server, samples, clients, per_client,
+                                 "recover")
+    everything = pre + fault + recover
+    ok = sum(1 for k, _ in everything if k)
+    fault_lat = sorted(lat for _, lat in fault)
+    p99_fault = fault_lat[max(0, int(len(fault_lat) * 0.99) - 1)] \
+        if fault_lat else 0.0
+    killed = next((r for r in server.stats()["replicas"] if r["id"] == 0),
+                  {})
+    return {
+        "availability": round(ok / max(1, len(everything)), 4),
+        "requests": len(everything),
+        "failed": len(everything) - ok,
+        "p99_during_fault_ms": round(p99_fault * 1e3, 2),
+        "failover_count": failover.value - f0,
+        "killed_replica_state": killed.get("state"),
+    }
+
+
 def _run_serve_mode(mx, models, image, num_layers, on_tpu):
     import threading
 
@@ -266,14 +342,22 @@ def _run_serve_mode(mx, models, image, num_layers, on_tpu):
                                     50 if on_tpu else 25))
     seq_iters = int(os.environ.get("BENCH_SERVE_SEQ_ITERS",
                                    30 if on_tpu else 12))
+    chaos = os.environ.get("BENCH_CHAOS") == "1"
+    replicas_cfg = int(os.environ.get("BENCH_SERVE_REPLICAS", "0") or 0)
+    if chaos and replicas_cfg == 0:
+        replicas_cfg = 2  # chaos needs a survivor to fail over to
 
     sym = models.resnet(num_classes=1000, num_layers=num_layers,
                         image_shape=",".join(map(str, image)))
     params = _random_inference_params(mx, sym, image)
-    server = ModelServer(
-        sym, params, {"data": image},
-        config=ServingConfig(buckets=buckets),
-        dev_type="gpu" if on_tpu else "cpu")
+
+    def make_server(n_replicas):
+        return ModelServer(
+            sym, params, {"data": image},
+            config=ServingConfig(buckets=buckets, replicas=n_replicas),
+            dev_type="gpu" if on_tpu else "cpu")
+
+    server = make_server(replicas_cfg)
     server.warmup()
     server.start()
 
@@ -324,6 +408,7 @@ def _run_serve_mode(mx, models, image, num_layers, on_tpu):
     total = sum(completed)
     snapshot = mx.telemetry.snapshot()
     lat = server.latency
+    n_replicas = len(server.replicas)
     record = {
         "metric": f"resnet{num_layers}_serving_throughput"
                   + ("" if on_tpu else "_cpusmoke"),
@@ -337,8 +422,36 @@ def _run_serve_mode(mx, models, image, num_layers, on_tpu):
         "errors": len(errors),
         "p50_ms": round(lat.percentile(50) / 1e3, 2),
         "p99_ms": round(lat.percentile(99) / 1e3, 2),
+        "replicas": n_replicas,
+        # per-replica batch counts over the SAME wall window: the
+        # replication scaling evidence (a starved replica shows up as a
+        # near-zero share, not as an invisible average)
+        "per_replica_batches": {r["id"]: r["batches"]
+                                for r in server.stats()["replicas"]},
         "telemetry": snapshot,
     }
+    if n_replicas > 1 and os.environ.get("BENCH_SERVE_SCALING", "1") != "0":
+        # the single-replica baseline under the SAME concurrent load:
+        # the ratio is the replication win the trajectory tracks
+        single = make_server(1)
+        single.warmup()
+        single.start()
+        tic = time.time()
+        results = _drive_serve_phase(single, samples, clients, per_client,
+                                     "single")
+        single_wall = time.time() - tic
+        single.close()
+        ok = sum(1 for k, _ in results if k)
+        record["single_replica_img_per_sec"] = round(ok / single_wall, 2)
+        if ok:
+            record["replica_scaling"] = round(
+                record["value"] / record["single_replica_img_per_sec"], 3)
+    if chaos:
+        record["chaos"] = _run_serve_chaos(mx, server, samples, clients,
+                                           per_client)
+        record["availability"] = record["chaos"]["availability"]
+        record["p99_during_fault_ms"] = \
+            record["chaos"]["p99_during_fault_ms"]
     server.close()
     print(json.dumps(record))
 

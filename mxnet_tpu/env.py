@@ -238,6 +238,76 @@ _declare("MXNET_SERVING_DEADLINE_MS", float, 0.0,
          "(serving.deadline_expired) rather than served after the client "
          "gave up. 0 (default) = no deadline; per-request deadline_ms "
          "overrides.")
+_declare("MXNET_SERVING_REPLICAS", int, 0,
+         "Model replicas in serving.ModelServer, one per mesh device "
+         "(jax local devices): every replica holds its own copy of the "
+         "per-bucket AOT executables + device-resident weights, and the "
+         "dynamic batcher routes each assembled batch to the least-loaded "
+         "HEALTHY replica (per-replica circuit breakers, failover "
+         "re-dispatch). 0 (default) = auto: all local accelerator devices "
+         "on TPU, 1 on CPU (the single-device server). Clamped to the "
+         "devices present.")
+_declare("MXNET_SERVING_REPLICA_TIMEOUT_MS", float, 0.0,
+         "Per-batch execution watchdog for serving replicas: a device "
+         "call exceeding this marks the replica suspect (circuit OPEN, "
+         "serving.replica.timeout) and the batch fails over to another "
+         "healthy replica instead of freezing the dispatch worker. "
+         "0 (default) = no watchdog (a hung call waits forever).")
+_declare("MXNET_SERVING_MAX_RETRIES", int, 2,
+         "Failover re-dispatches of a failed serving batch (after its "
+         "first attempt) before the error reaches clients. Retries stay "
+         "inside the batch's deadline budget and only apply to execution "
+         "faults (idempotent pure forwards) — typed admission errors are "
+         "never retried.")
+_declare("MXNET_SERVING_HEDGE_MS", float, 0.0,
+         "Tail-latency hedging: a serving batch still unanswered after "
+         "this many milliseconds is duplicated to a second healthy "
+         "replica; the first result wins and the loser is cancelled/"
+         "discarded (serving.replica.hedge / hedge_win). 0 (default) = "
+         "off. Costs duplicate device work on the hedged tail — size it "
+         "at ~p99 of healthy latency.")
+_declare("MXNET_SERVING_CB_ERRORS", int, 3,
+         "Consecutive errors (or, with MXNET_SERVING_CB_SLOW_MS, "
+         "consecutive slow calls) that trip a serving replica's circuit "
+         "breaker OPEN (serving.replica.open). An open replica takes no "
+         "traffic until a half-open probe succeeds.")
+_declare("MXNET_SERVING_CB_PROBE_MS", float, 100.0,
+         "Initial half-open backoff of a serving replica's circuit "
+         "breaker: after this long OPEN, exactly one live request is "
+         "routed through as a probe; success closes the breaker, failure "
+         "re-opens it with the backoff doubled (capped at 10 s).")
+_declare("MXNET_SERVING_CB_SLOW_MS", float, 0.0,
+         "Slow-call threshold for the serving circuit breaker: "
+         "successful replica calls slower than this count toward "
+         "MXNET_SERVING_CB_ERRORS like errors (a replica that still "
+         "answers but 100x late is down for SLO purposes). 0 (default) "
+         "= only real errors count.")
+_declare("MXNET_SERVING_MAX_BODY_BYTES", int, 64 << 20,
+         "HTTP request-body cap for serving/http.py: a POST whose "
+         "Content-Length exceeds this is refused with 413 BEFORE the "
+         "body is read into memory. 0 disables the cap.")
+_declare("MXNET_FI_SERVE_RAISE_REPLICA", str, "",
+         "Fault injection (serving chaos): comma-separated replica ids "
+         "whose forward raises — kills replica R under traffic (circuit "
+         "opens, batches fail over). Re-read per call: clear it to "
+         "revive the replica via the half-open probe.")
+_declare("MXNET_FI_SERVE_LATENCY_MS", float, 0.0,
+         "Fault injection (serving chaos): sleep injected into the "
+         "replica forward (watchdog/hedging fuel), on the replica named "
+         "by MXNET_FI_SERVE_LATENCY_REPLICA.")
+_declare("MXNET_FI_SERVE_LATENCY_REPLICA", int, -1,
+         "Replica id the injected serving latency applies to "
+         "(-1 = every replica).")
+_declare("MXNET_FI_SERVE_FAIL_EVERY", int, 0,
+         "Fault injection (serving chaos): fail every Nth serving batch "
+         "attempt (process-global ordinal) — intermittent faults the "
+         "failover re-dispatch must absorb with zero client errors. "
+         "0 = off.")
+_declare("MXNET_FI_SERVE_RELOAD_CORRUPT", str, "",
+         "Fault injection (serving chaos): comma-separated replica ids "
+         "whose hot reload raises mid-swap — the server must eject that "
+         "replica (serving.replica.ejected) and keep the pool serving "
+         "the new weights on the others.")
 _declare("MXNET_SERVING_WATCH", float, 0.0,
          "Seconds between polls of the serving watch directory's LATEST "
          "pointer (a PR-4 checkpoint dir): when it names a new "

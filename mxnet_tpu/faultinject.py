@@ -28,6 +28,31 @@ fault without patching framework code:
                                 (default 17).
 ==============================  =============================================
 
+Serving-path faults (the chaos harness for ``mxnet_tpu/serving``; same
+``MXNET_FI_ATTEMPT``/``MXNET_FI_RANK`` gating, read per call so a test —
+or ``bench.py BENCH_CHAOS=1`` — can kill and revive a replica at runtime
+by mutating ``os.environ``):
+
+==================================  =========================================
+``MXNET_FI_SERVE_RAISE_REPLICA``    comma-separated replica ids whose
+                                    forward raises (kill replica R — drives
+                                    circuit-breaker open + batch failover).
+``MXNET_FI_SERVE_LATENCY_MS``       sleep this long inside the replica
+                                    forward (tail-latency / watchdog /
+                                    hedging fuel), on the replica named by
+                                    ``MXNET_FI_SERVE_LATENCY_REPLICA``
+                                    (-1 = every replica).
+``MXNET_FI_SERVE_FAIL_EVERY``       fail every Nth serving batch attempt
+                                    (process-global ordinal, any replica) —
+                                    the intermittent-fault mode failover
+                                    must absorb without client errors.
+``MXNET_FI_SERVE_RELOAD_CORRUPT``   comma-separated replica ids whose hot
+                                    reload raises mid-swap — exercises
+                                    per-replica ejection (a reload failure
+                                    on one replica must not poison the
+                                    pool).
+==================================  =========================================
+
 All hooks are no-ops (one cheap env check) when nothing is configured;
 ``Module.fit`` disables train-window fusion while injection is active so
 batch ordinals stay exact.
@@ -44,6 +69,7 @@ from .io import DataIter
 
 _lock = threading.Lock()
 _batch_ordinal = -1  # process-global count of train batches seen by fit
+_serve_ordinal = 0   # process-global count of serving batch attempts
 
 
 def _csv_ints(name):
@@ -83,10 +109,11 @@ def active():
 
 
 def reset():
-    """Rewind the process-global batch ordinal (tests only)."""
-    global _batch_ordinal
+    """Rewind the process-global batch ordinals (tests only)."""
+    global _batch_ordinal, _serve_ordinal
     with _lock:
         _batch_ordinal = -1
+        _serve_ordinal = 0
 
 
 def on_train_batch(data_batch):
@@ -130,6 +157,65 @@ def _poison_batch(data_batch):
     data_batch.data = poisoned
     data_batch.staged = False  # re-stage: the arrays are new
     return data_batch
+
+
+def serving_active():
+    """True when any serving-path fault is configured for THIS launcher
+    attempt+rank (separate from :func:`active` — serving faults must not
+    flip fit's window-fusion opt-out)."""
+    if not any(os.environ.get(k) for k in (
+            "MXNET_FI_SERVE_RAISE_REPLICA", "MXNET_FI_SERVE_LATENCY_MS",
+            "MXNET_FI_SERVE_FAIL_EVERY", "MXNET_FI_SERVE_RELOAD_CORRUPT")):
+        return False
+    return _attempt_matches() and _rank_matches()
+
+
+def on_serving_forward(replica_id):
+    """Per-batch hook inside ``serving.Replica._call`` (under the replica
+    lock, exactly where a real device fault would land): may sleep
+    (inject-latency), raise (kill replica R / fail every Nth batch), or
+    do nothing. Env is re-read per call so chaos tests flip faults on and
+    off at runtime."""
+    global _serve_ordinal
+    if not serving_active():
+        return
+    lat = float(os.environ.get("MXNET_FI_SERVE_LATENCY_MS", "0") or 0)
+    if lat > 0:
+        who = int(os.environ.get("MXNET_FI_SERVE_LATENCY_REPLICA", "-1")
+                  or -1)
+        if who < 0 or who == replica_id:
+            _tm.counter("faultinject.serve_latency").inc()
+            import time
+
+            time.sleep(lat / 1e3)
+    if replica_id in _csv_ints("MXNET_FI_SERVE_RAISE_REPLICA"):
+        _tm.counter("faultinject.serve_raise").inc()
+        raise MXNetError(
+            f"faultinject: injected forward failure on replica "
+            f"{replica_id}")
+    every = int(os.environ.get("MXNET_FI_SERVE_FAIL_EVERY", "0") or 0)
+    if every > 0:
+        with _lock:
+            _serve_ordinal += 1
+            ordinal = _serve_ordinal
+        if ordinal % every == 0:
+            _tm.counter("faultinject.serve_raise").inc()
+            raise MXNetError(
+                f"faultinject: injected failure at serving batch "
+                f"{ordinal} (every {every})")
+
+
+def on_serving_reload(replica_id):
+    """Hook at the top of ``ModelServer._reload_replica``: an injected
+    raise models a corrupt per-replica weight transfer — the server must
+    eject that replica and keep the pool serving."""
+    if not serving_active():
+        return
+    if replica_id in _csv_ints("MXNET_FI_SERVE_RELOAD_CORRUPT"):
+        _tm.counter("faultinject.serve_reload_corrupt").inc()
+        raise MXNetError(
+            f"faultinject: injected reload corruption on replica "
+            f"{replica_id}")
 
 
 def post_checkpoint_commit(params_path):

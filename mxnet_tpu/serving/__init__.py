@@ -18,22 +18,36 @@ TPU serving is won one layer up, where this package lives:
   hot-swaps weights between batches (:meth:`ModelServer.reload`, or
   ``MXNET_SERVING_WATCH`` polling a checkpoint directory's ``LATEST``
   pointer) without dropping in-flight requests.
+- :class:`ReplicaPool` replicates the bucket executables across mesh
+  devices (``MXNET_SERVING_REPLICAS``) and routes every batch to the
+  least-loaded *healthy* replica: per-replica circuit breakers with
+  exponential half-open probes, a per-batch execution watchdog
+  (``MXNET_SERVING_REPLICA_TIMEOUT_MS``), failover re-dispatch of failed
+  batches (``MXNET_SERVING_MAX_RETRIES``), optional tail-latency hedging
+  (``MXNET_SERVING_HEDGE_MS``), and proportional admission shedding as
+  healthy capacity drops (all-down fails fast with
+  :class:`NoHealthyReplicas`, never a hang).
 - :func:`serve_http` / ``tools/serve.py`` expose it over a stdlib
-  threaded HTTP frontend (``POST /predict``, ``GET /healthz``,
-  ``GET /metrics`` Prometheus text).
+  threaded HTTP frontend (``POST /predict``, ``GET /healthz`` —
+  readiness-aware: 503 when no replica is healthy, ``degraded: true``
+  when only some are — ``GET /metrics`` Prometheus text).
 
 See ``docs/serving.md`` for architecture and tuning.
 """
 
 from .batcher import DynamicBatcher
-from .errors import (DeadlineExceeded, ServerClosed, ServerOverloaded,
-                     ServingError)
+from .errors import (DeadlineExceeded, NoHealthyReplicas, ReplicaTimeout,
+                     ServerClosed, ServerOverloaded, ServingError,
+                     WorkerCrashed)
 from .http import make_http_server, serve_http
 from .metrics import LatencyHistogram
+from .replica import Replica, ReplicaPool
 from .server import ModelServer, ServingConfig
 
 __all__ = [
-    "DynamicBatcher", "LatencyHistogram", "ModelServer", "ServingConfig",
+    "DynamicBatcher", "LatencyHistogram", "ModelServer", "Replica",
+    "ReplicaPool", "ServingConfig",
     "ServingError", "ServerOverloaded", "DeadlineExceeded", "ServerClosed",
+    "NoHealthyReplicas", "ReplicaTimeout", "WorkerCrashed",
     "make_http_server", "serve_http",
 ]

@@ -11,7 +11,8 @@ from __future__ import annotations
 from ..base import MXNetError
 
 __all__ = ["ServingError", "ServerOverloaded", "DeadlineExceeded",
-           "ServerClosed"]
+           "ServerClosed", "NoHealthyReplicas", "ReplicaTimeout",
+           "WorkerCrashed"]
 
 
 class ServingError(MXNetError):
@@ -34,3 +35,27 @@ class ServerClosed(ServingError):
     """The server is draining or closed and accepts no new requests.
     In-flight and already-queued requests still complete (graceful
     drain)."""
+
+
+class NoHealthyReplicas(ServingError):
+    """Every replica's circuit breaker is open (or ejected) and none is
+    yet probe-eligible — the request fails fast and typed instead of
+    queueing toward a deadline that cannot be met. Clients should back
+    off; the HTTP frontend returns 503 with ``Retry-After``. Counted in
+    ``serving.no_capacity``."""
+
+
+class ReplicaTimeout(ServingError):
+    """A batch exceeded the per-replica execution watchdog
+    (``MXNET_SERVING_REPLICA_TIMEOUT_MS``). The replica is marked suspect
+    (breaker OPEN) and the batch fails over; this error surfaces only
+    when every failover attempt also failed. The one ``ServingError``
+    the pool retries — a timeout is an infrastructure fault, not an
+    admission verdict."""
+
+
+class WorkerCrashed(ServingError):
+    """The batcher worker hit an unhandled error outside the per-batch
+    guard; pending requests are failed with this (instead of stranding
+    their futures forever) and the worker restarts. Counted in
+    ``serving.worker_crash``; HTTP 500."""

@@ -11,12 +11,23 @@ batching layer, not the HTTP layer, owns concurrency. Endpoints:
   little-endian sample bytes in the input's bound dtype; with ``Accept:
   application/octet-stream`` the response is output 0's raw float32 bytes
   (``X-Output-Shape`` header).
-- ``GET /healthz`` — ``ModelServer.stats()`` JSON; 503 while draining.
+- ``GET /healthz`` — readiness-aware ``ModelServer.stats()`` JSON: 200
+  when serving (``degraded: true`` and per-replica states when only part
+  of the replica pool is healthy), 503 with the same body while draining
+  or when ZERO replicas are healthy — an external load balancer can eject
+  the process on status alone.
 - ``GET /metrics`` — Prometheus text from the PR-2 telemetry registry
   (every ``mxnet_serving_*`` instrument plus the rest of the framework).
 
-Error mapping: 400 malformed request, 503 ``ServerOverloaded`` (with
-``Retry-After``) / ``ServerClosed``, 504 ``DeadlineExceeded``.
+Request bodies are capped at ``MXNET_SERVING_MAX_BODY_BYTES``
+(``ServingConfig.max_body_bytes``): an oversized POST is refused with 413
+from its ``Content-Length`` alone, BEFORE the body is read into memory —
+admission control must run before the allocation it guards.
+
+Error mapping: 400 malformed request, 413 body too large, 503
+``ServerOverloaded`` / ``NoHealthyReplicas`` (with ``Retry-After``) /
+``ServerClosed``, 504 ``DeadlineExceeded`` / ``ReplicaTimeout``, 500
+``WorkerCrashed`` / unexpected inference errors.
 """
 
 from __future__ import annotations
@@ -29,7 +40,8 @@ import numpy as np
 
 from .. import telemetry as _tm
 from ..base import MXNetError
-from .errors import DeadlineExceeded, ServerClosed, ServerOverloaded
+from .errors import (DeadlineExceeded, NoHealthyReplicas, ReplicaTimeout,
+                     ServerClosed, ServerOverloaded, WorkerCrashed)
 
 __all__ = ["make_http_server", "serve_http"]
 
@@ -66,7 +78,11 @@ def _make_handler(model_server):
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
             if self.path == "/healthz":
                 stats = model_server.stats()
-                code = 200 if stats["status"] == "ok" else 503
+                # readiness: "degraded" still serves (200 + degraded flag
+                # in the body, so an LB can weigh the process down);
+                # "unavailable" (zero healthy replicas) and "draining"
+                # are 503 WITH the body — the why rides along
+                code = 200 if stats["status"] in ("ok", "degraded") else 503
                 self._send(code, stats)
             elif self.path == "/metrics":
                 self._send(200, _tm.prometheus(),
@@ -76,16 +92,38 @@ def _make_handler(model_server):
 
         # -- POST ------------------------------------------------------
         def do_POST(self):  # noqa: N802
+            try:
+                length = int(self.headers.get("Content-Length", 0) or 0)
+            except ValueError:
+                # a malformed Content-Length means the body framing is
+                # unknowable: answer 400 and close rather than let the
+                # exception drop the connection with no response
+                self.close_connection = True
+                self._error(400, "malformed Content-Length header",
+                            headers={"Connection": "close"})
+                return
+            cap = model_server.config.max_body_bytes
+            if cap and length > cap:
+                # refuse from the declared length BEFORE reading: the
+                # whole point of the cap is that an oversized body never
+                # reaches memory. The unread body makes the connection
+                # unusable for keep-alive, so close it
+                _tm.counter("serving.http.body_too_large").inc()
+                self.close_connection = True
+                self._error(413,
+                            f"request body {length} bytes exceeds the "
+                            f"{cap}-byte cap (MXNET_SERVING_MAX_BODY_"
+                            "BYTES)", headers={"Connection": "close"})
+                return
             if self.path != "/predict":
                 # drain the body first: on a keep-alive (HTTP/1.1)
                 # connection an unread body would be parsed as the NEXT
                 # request line, corrupting the connection for the client
-                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                self.rfile.read(length)
                 self._error(404, f"unknown path {self.path}")
                 return
             _tm.counter("serving.http.request").inc()
             try:
-                length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 ctype = (self.headers.get("Content-Type") or
                          "application/json").split(";")[0].strip()
@@ -95,10 +133,25 @@ def _make_handler(model_server):
             except ServerOverloaded as e:
                 _tm.counter("serving.http.shed").inc()
                 self._error(503, str(e), headers={"Retry-After": "1"})
+            except NoHealthyReplicas as e:
+                # whole pool down: typed fast 503 so the client (and its
+                # LB) backs off instead of timing out request by request
+                _tm.counter("serving.http.no_capacity").inc()
+                self._error(503, str(e), headers={"Retry-After": "1"})
             except DeadlineExceeded as e:
+                self._error(504, str(e))
+            except ReplicaTimeout as e:
+                # every failover attempt timed out: a server-side
+                # infrastructure fault — 504, never the MXNetError→400
+                # branch (ReplicaTimeout subclasses it)
                 self._error(504, str(e))
             except ServerClosed as e:
                 self._error(503, str(e))
+            except WorkerCrashed as e:
+                # an internal fault, not a client error: 500, and before
+                # the MXNetError → 400 branch (WorkerCrashed subclasses
+                # it)
+                self._error(500, str(e))
             except (MXNetError, ValueError, KeyError, TypeError,
                     json.JSONDecodeError) as e:
                 self._error(400, str(e))

@@ -1,27 +1,36 @@
-"""ModelServer: bucketed AOT inference with admission control and hot
-reload.
+"""ModelServer: replicated, bucketed AOT inference with admission control,
+health-gated failover and hot reload.
 
 The serving pillar of the framework (ROADMAP: "serves heavy traffic from
-millions of users"). A :class:`ModelServer` owns one
-:class:`~mxnet_tpu.predictor.Predictor` per configured bucket batch size,
-all sharing one folded symbol and one set of device-resident weights, plus
-a :class:`~mxnet_tpu.serving.batcher.DynamicBatcher` that coalesces
-concurrent requests into those fixed shapes. The contract that wins TPU
-serving latency: **the bucket set is the complete program universe** —
-:meth:`warmup` compiles every bucket (persisting executables through the
-PR-3 AOT cache when ``MXNET_AOT_CACHE`` is on) before the first request is
-admitted, so the request path never traces or compiles
-(``executor.jit_compile`` stays at its warmup value; counter-verified in
-``tests/test_serving.py``).
+millions of users"). A :class:`ModelServer` owns N :class:`Replica`\\ s —
+one per mesh device (``MXNET_SERVING_REPLICAS``; N=1 degenerates to the
+single-device server) — each holding one
+:class:`~mxnet_tpu.predictor.Predictor` per configured bucket batch size
+over its own device-resident copy of the weights, plus a
+:class:`~mxnet_tpu.serving.batcher.DynamicBatcher` that coalesces
+concurrent requests into those fixed shapes and a
+:class:`~mxnet_tpu.serving.replica.ReplicaPool` that routes every
+assembled batch to the least-loaded healthy replica (circuit breakers,
+watchdog timeouts, failover re-dispatch, optional hedging — see
+``serving/replica.py``). The contract that wins TPU serving latency: **the
+bucket set is the complete program universe** — :meth:`warmup` compiles
+every (replica, bucket) executable (persisting through the PR-3 AOT cache
+when ``MXNET_AOT_CACHE`` is on) before the first request is admitted, so
+the request path never traces or compiles, *including failover and hedged
+re-dispatches* (``executor.jit_compile`` stays at its warmup value;
+counter-verified in ``tests/test_serving.py`` and
+``tests/test_serving_chaos.py``).
 
 Hot reload (:meth:`reload`) swaps weights from a PR-4 checkpoint directory
 (digest-verified ``checkpoint.load_latest``), a ``.params`` file, or an
-in-memory dict — atomically between batches (the batcher's run lock), so
-in-flight requests complete against a consistent weight set and nothing is
-dropped. ``MXNET_SERVING_WATCH`` (or ``ServingConfig(watch_dir=...)``)
-polls the checkpoint ``LATEST`` pointer and reloads on change — the
-train→serve hand-off needs no orchestration beyond the trainer committing
-checkpoints.
+in-memory dict — per replica, atomically between that replica's batches
+(its lock), so in-flight requests complete against a consistent weight set
+and nothing is dropped; a reload that fails on one replica **ejects** that
+replica from the pool instead of poisoning it, and the remaining replicas
+serve the new weights. ``MXNET_SERVING_WATCH`` (or
+``ServingConfig(watch_dir=...)``) polls the checkpoint ``LATEST`` pointer
+and reloads on change — the train→serve hand-off needs no orchestration
+beyond the trainer committing checkpoints.
 """
 
 from __future__ import annotations
@@ -39,6 +48,7 @@ from ..base import MXNetError
 from .batcher import DynamicBatcher
 from .errors import ServerClosed
 from .metrics import LatencyHistogram
+from .replica import Replica, ReplicaPool
 
 __all__ = ["ServingConfig", "ModelServer"]
 
@@ -66,10 +76,13 @@ class ServingConfig:
         bucket dispatches. The throughput/latency dial: 0 disables
         coalescing beyond what queues naturally during inference.
     queue_depth : int
-        Admission bound; a full queue sheds (``ServerOverloaded``).
+        Admission bound; a full queue sheds (``ServerOverloaded``). The
+        effective bound scales with the healthy-replica fraction
+        (graceful degradation under partial failure).
     deadline_ms : float
         Default per-request deadline (0 = none). A request whose deadline
-        passes while queued is dropped with ``DeadlineExceeded``.
+        passes while queued is dropped with ``DeadlineExceeded``; the
+        same budget bounds failover re-dispatch.
     watch_dir : str or None
         Checkpoint directory to poll for hot reload (the ``LATEST``
         pointer file).
@@ -78,14 +91,43 @@ class ServingConfig:
     fold_bn : bool
         Fold inference BatchNorms into their producers once, server-wide
         (same deployment optimization the Predictor applies).
+    replicas : int
+        Model replicas, one per device. 0 (default) = auto: every local
+        accelerator device on TPU, 1 on CPU (today's single-device
+        behavior). Clamped to the devices actually present.
+    replica_timeout_ms : float
+        Per-batch execution watchdog: a replica call exceeding this is
+        abandoned (breaker OPEN, ``serving.replica.timeout``) and the
+        batch fails over. 0 = no watchdog.
+    max_retries : int
+        Failover re-dispatches of a failed batch (after the first
+        attempt) before the error surfaces to clients.
+    hedge_ms : float
+        Tail-latency hedging: a batch unanswered after this delay is
+        duplicated to a second healthy replica; first result wins.
+        0 = off.
+    cb_errors : int
+        Consecutive errors (or slow calls) that trip a replica's circuit
+        breaker OPEN.
+    cb_probe_ms : float
+        Initial half-open probe backoff; doubles per failed probe.
+    cb_slow_ms : float
+        Successful calls slower than this count toward the breaker
+        (0 = only errors count).
+    max_body_bytes : int
+        HTTP request-body cap (413 beyond it, before the body is read).
     """
 
     __slots__ = ("buckets", "max_delay", "queue_depth", "deadline",
-                 "watch_dir", "watch_period", "fold_bn")
+                 "watch_dir", "watch_period", "fold_bn", "replicas",
+                 "replica_timeout", "max_retries", "hedge", "cb_errors",
+                 "cb_probe", "cb_slow", "max_body_bytes")
 
     def __init__(self, buckets=None, max_delay_ms=None, queue_depth=None,
                  deadline_ms=None, watch_dir=None, watch_period=None,
-                 fold_bn=True):
+                 fold_bn=True, replicas=None, replica_timeout_ms=None,
+                 max_retries=None, hedge_ms=None, cb_errors=None,
+                 cb_probe_ms=None, cb_slow_ms=None, max_body_bytes=None):
         if buckets is None:
             buckets = _env.get("MXNET_SERVING_BUCKETS")
         if isinstance(buckets, str):
@@ -93,20 +135,40 @@ class ServingConfig:
         else:
             buckets = _parse_buckets(",".join(map(str, buckets)))
         self.buckets = buckets
-        if max_delay_ms is None:
-            max_delay_ms = _env.get("MXNET_SERVING_MAX_DELAY_MS")
-        self.max_delay = max(0.0, float(max_delay_ms)) / 1e3
+
+        def _ms(value, env_name, floor=0.0):
+            if value is None:
+                value = _env.get(env_name)
+            return max(floor, float(value)) / 1e3
+
+        self.max_delay = _ms(max_delay_ms, "MXNET_SERVING_MAX_DELAY_MS")
         if queue_depth is None:
             queue_depth = _env.get("MXNET_SERVING_QUEUE_DEPTH")
         self.queue_depth = max(1, int(queue_depth))
-        if deadline_ms is None:
-            deadline_ms = _env.get("MXNET_SERVING_DEADLINE_MS")
-        self.deadline = max(0.0, float(deadline_ms)) / 1e3
+        self.deadline = _ms(deadline_ms, "MXNET_SERVING_DEADLINE_MS")
         self.watch_dir = os.fspath(watch_dir) if watch_dir else None
         if watch_period is None:
             watch_period = _env.get("MXNET_SERVING_WATCH")
         self.watch_period = max(0.0, float(watch_period))
         self.fold_bn = bool(fold_bn)
+        if replicas is None:
+            replicas = _env.get("MXNET_SERVING_REPLICAS")
+        self.replicas = max(0, int(replicas))
+        self.replica_timeout = _ms(replica_timeout_ms,
+                                   "MXNET_SERVING_REPLICA_TIMEOUT_MS")
+        if max_retries is None:
+            max_retries = _env.get("MXNET_SERVING_MAX_RETRIES")
+        self.max_retries = max(0, int(max_retries))
+        self.hedge = _ms(hedge_ms, "MXNET_SERVING_HEDGE_MS")
+        if cb_errors is None:
+            cb_errors = _env.get("MXNET_SERVING_CB_ERRORS")
+        self.cb_errors = max(1, int(cb_errors))
+        self.cb_probe = _ms(cb_probe_ms, "MXNET_SERVING_CB_PROBE_MS",
+                            floor=1.0)
+        self.cb_slow = _ms(cb_slow_ms, "MXNET_SERVING_CB_SLOW_MS")
+        if max_body_bytes is None:
+            max_body_bytes = _env.get("MXNET_SERVING_MAX_BODY_BYTES")
+        self.max_body_bytes = max(0, int(max_body_bytes))
 
 
 def _load_params(source):
@@ -147,7 +209,7 @@ def _load_params(source):
 
 
 class ModelServer:
-    """Batched, bucketed, overload-protected inference server.
+    """Batched, bucketed, replicated, overload-protected inference server.
 
     Parameters
     ----------
@@ -164,9 +226,10 @@ class ModelServer:
         Input dtypes (token-id inputs should be integer — forwarded to
         each bucket ``Predictor``).
 
-    Lifecycle: ``warmup()`` (compile every bucket) → ``start()`` (accept
-    traffic; implies warmup) → ``submit``/``predict`` → ``close()``
-    (drain + stop). ``reload()`` may be called at any point while serving.
+    Lifecycle: ``warmup()`` (compile every replica × bucket) → ``start()``
+    (accept traffic; implies warmup) → ``submit``/``predict`` →
+    ``close()`` (drain + stop). ``reload()`` may be called at any point
+    while serving.
     """
 
     def __init__(self, symbol, params, input_shapes, config=None, ctx=None,
@@ -192,21 +255,36 @@ class ModelServer:
         self._input_names = tuple(self._sample_shapes)
         self._input_types = dict(input_types or {})
         self._ctx = ctx or Context(dev_type, dev_id)
-        # move weights to the device ONCE: every bucket predictor's _bind
-        # then binds the same device-resident arrays (as_in_context is a
-        # no-op in-context) instead of copying the full weight set per
-        # bucket — one HBM copy and one host→device transfer, not
-        # len(buckets) of each
-        arg_params = self._to_ctx(arg_params)
-        aux_params = self._to_ctx(aux_params)
 
-        self._predictors = {}
-        for b in self.config.buckets:
-            shapes = {n: (b,) + s for n, s in self._sample_shapes.items()}
-            self._predictors[b] = Predictor(
-                self._symbol, self._combined(arg_params, aux_params),
-                shapes, ctx=self._ctx,
-                fold_bn=False, input_types=self._input_types or None)
+        replicas = []
+        for rid, rctx in enumerate(self._replica_contexts()):
+            # move weights to EACH replica's device once: that replica's
+            # bucket predictors then all bind the same device-resident
+            # arrays (as_in_context is a no-op in-context) — one HBM copy
+            # and one host→device transfer per replica, not per bucket
+            r_args = self._to_ctx(arg_params, rctx)
+            r_aux = self._to_ctx(aux_params, rctx)
+            preds = {}
+            for b in self.config.buckets:
+                shapes = {n: (b,) + s
+                          for n, s in self._sample_shapes.items()}
+                preds[b] = Predictor(
+                    self._symbol, self._combined(r_args, r_aux),
+                    shapes, ctx=rctx,
+                    fold_bn=False, input_types=self._input_types or None)
+            replicas.append(Replica(rid, rctx, preds))
+        self._pool = ReplicaPool(
+            replicas,
+            timeout=self.config.replica_timeout,
+            max_retries=self.config.max_retries,
+            hedge=self.config.hedge,
+            cb_errors=self.config.cb_errors,
+            cb_probe=self.config.cb_probe,
+            cb_slow=self.config.cb_slow,
+            logger=self.logger)
+        # replica 0's predictors, for benchmarks/tests that drive a
+        # bucket program directly (srv.predictor(b))
+        self._predictors = replicas[0].predictors
         from ..base import np_dtype
 
         p1 = self._predictors[self.config.buckets[0]]
@@ -222,11 +300,12 @@ class ModelServer:
             max_delay=self.config.max_delay,
             queue_depth=self.config.queue_depth,
             latency_observer=self.latency.observe_us,
+            capacity_fn=self._pool.capacity_fraction,
+            dispatch_concurrency=len(replicas),
         )
-        # stamp each future with the weight version its batch computed
-        # against (read under the run lock — reload bumps version under
-        # the same lock, so the label can never be a version the batch
-        # did not actually use)
+        # legacy note hook (patched bare-list runners in tests): the pool
+        # runner supersedes it by returning (outs, note) with the weight
+        # version read under the serving replica's lock
         self._batcher.annotate = lambda: {"version": self.version}
         self._warm = False
         self._closed = False
@@ -242,6 +321,37 @@ class ModelServer:
             loaded_commit if self._is_watch_dir(params) else None)
 
     # -- construction helpers ------------------------------------------
+    def _replica_contexts(self):
+        """One Context per replica. ``config.replicas == 0`` is auto: all
+        local accelerator devices on TPU, 1 on CPU (the single-device
+        server of old). A request beyond the devices present clamps with
+        a warning — a half-provisioned pool beats a refusal to serve."""
+        import jax
+
+        from ..context import Context
+
+        dev_type = self._ctx.device_type
+        if dev_type in ("cpu", "cpu_pinned"):
+            avail = len(jax.devices("cpu"))
+            on_accel = False
+        else:
+            devs = jax.devices()
+            avail = len(devs)
+            on_accel = bool(devs) and devs[0].platform != "cpu"
+        want = self.config.replicas
+        if want == 0:
+            want = avail if on_accel else 1
+        if want > avail:
+            self.logger.warning(
+                "serving: %d replicas requested but only %d %s device(s) "
+                "present; clamping", want, avail, dev_type)
+            want = avail
+        if want <= 1:
+            return [self._ctx]
+        ids = [self._ctx.device_id] + [
+            i for i in range(avail) if i != self._ctx.device_id]
+        return [Context(dev_type, i) for i in ids[:want]]
+
     def _fold(self, sym, arg_params, aux_params):
         """Fold inference BatchNorms ONCE at the server level; every
         bucket predictor then shares the folded symbol and weights (the
@@ -264,10 +374,11 @@ class ModelServer:
         self._fold_active = True
         return folded_sym, folded_args, aux_params
 
-    def _to_ctx(self, params):
+    def _to_ctx(self, params, ctx=None):
         from ..ndarray import NDArray
 
-        return {k: v.as_in_context(self._ctx)
+        ctx = ctx or self._ctx
+        return {k: v.as_in_context(ctx)
                 if isinstance(v, NDArray) else v
                 for k, v in params.items()}
 
@@ -284,39 +395,51 @@ class ModelServer:
         d.update({f"aux:{k}": v for k, v in aux_params.items()})
         return d
 
-    def predictor(self, bucket):
-        """The bucket's underlying Predictor (benchmarks/tests; do not
-        drive it while traffic is flowing — the batcher owns it)."""
-        return self._predictors[bucket]
+    def predictor(self, bucket, replica=0):
+        """A replica's underlying Predictor for one bucket
+        (benchmarks/tests; do not drive it while traffic is flowing —
+        the batcher owns it)."""
+        return self._pool.replicas[replica].predictors[bucket]
+
+    @property
+    def replicas(self):
+        """The replica pool's replicas (read-mostly introspection)."""
+        return self._pool.replicas
 
     # -- lifecycle -----------------------------------------------------
     def warmup(self):
-        """Compile (or AOT-cache-deserialize) every bucket's inference
-        program before traffic. Buckets compile concurrently (XLA
-        compilation releases the GIL — same recipe as
+        """Compile (or AOT-cache-deserialize) every (replica, bucket)
+        inference program before traffic. Programs compile concurrently
+        (XLA compilation releases the GIL — same recipe as
         ``BucketingModule.compile``), so a cold start costs roughly one
-        compile, not one per bucket. With ``MXNET_AOT_CACHE=1`` the
+        compile, not one per program. With ``MXNET_AOT_CACHE=1`` the
         compiled executables persist, so the NEXT server process warms
-        from disk without touching XLA. Returns {bucket: compiled kinds}."""
+        from disk without touching XLA. Returns
+        {replica: {bucket: compiled kinds}}."""
         from concurrent.futures import ThreadPoolExecutor
 
-        done = {}
+        items = [(rep.rid, b, pred)
+                 for rep in self._pool.replicas
+                 for b, pred in rep.predictors.items()]
+        done = {rep.rid: {} for rep in self._pool.replicas}
         with _tm.span("serving.warmup"):
-            items = list(self._predictors.items())
             if len(items) > 1:
                 with ThreadPoolExecutor(
                         max_workers=min(len(items),
                                         os.cpu_count() or 1)) as pool:
-                    futs = {b: pool.submit(pred._exec.compile, ["forward"])
-                            for b, pred in items}
-                    done = {b: f.result() for b, f in futs.items()}
+                    futs = {(rid, b): pool.submit(pred._exec.compile,
+                                                  ["forward"])
+                            for rid, b, pred in items}
+                    for (rid, b), f in futs.items():
+                        done[rid][b] = f.result()
             else:
-                for b, pred in items:
-                    done[b] = pred._exec.compile(["forward"])
+                for rid, b, pred in items:
+                    done[rid][b] = pred._exec.compile(["forward"])
         self._warm = True
-        _tm.counter("serving.warmup_buckets").inc(len(done))
-        self.logger.info("serving: warmed buckets %s",
-                         list(self._predictors))
+        _tm.counter("serving.warmup_buckets").inc(len(items))
+        self.logger.info(
+            "serving: warmed %d replica(s) x buckets %s",
+            len(self._pool.replicas), list(self.config.buckets))
         return done
 
     def start(self):
@@ -350,6 +473,7 @@ class ModelServer:
         if self._watcher is not None:
             self._watcher.join(timeout=5.0)
             self._watcher = None
+        self._pool.close()
 
     def __enter__(self):
         return self.start()
@@ -387,7 +511,8 @@ class ModelServer:
     def submit(self, inputs, deadline_ms=None):
         """Admit one request; returns a ``Future`` resolving to the list
         of output arrays (one per model output, per-sample shape).
-        Sheds with ``ServerOverloaded`` when the queue is full."""
+        Sheds with ``ServerOverloaded`` when the (capacity-scaled) queue
+        is full, ``NoHealthyReplicas`` when the whole pool is down."""
         if self._closed:
             raise ServerClosed("server closed")
         coerced = self._coerce(inputs)
@@ -402,10 +527,13 @@ class ModelServer:
         return self.submit(inputs, deadline_ms=deadline_ms).result(timeout)
 
     def _infer(self, bucket, stacked, n_valid):
-        """Batcher runner: one atomic forward on the bucket's predictor.
-        Returns outputs batch-major (numpy); rows >= n_valid are padding
-        the batcher discards."""
-        return self._predictors[bucket].run(**stacked)
+        """Batcher runner: route the batch through the replica pool
+        (least-loaded healthy replica; watchdog/hedge/failover). Returns
+        ``(outputs, note)`` — the note carries the weight version and
+        replica id the batch actually computed against."""
+        return self._pool.run_batch(
+            bucket, stacked, n_valid,
+            deadline=self._batcher.batch_deadline())
 
     # -- hot reload ----------------------------------------------------
     def reload(self, source=None):
@@ -413,10 +541,15 @@ class ModelServer:
         file / blob / dict; None = the configured ``watch_dir``) without
         dropping in-flight requests.
 
-        The swap happens under the batcher's run lock, i.e. strictly
-        BETWEEN batches: every response is computed against exactly one
-        weight version. Queued requests simply run against the new
-        weights when their batch dispatches."""
+        Each replica swaps under its own lock, i.e. strictly BETWEEN its
+        batches: every response is computed against exactly one weight
+        version, and other replicas keep serving during the swap. A
+        replica whose swap fails (corrupt transfer, hung device — its
+        lock cannot even be acquired) is **ejected** from the pool
+        (``serving.replica.ejected``) rather than serving mixed weights;
+        the reload succeeds if at least one replica swapped. Only when
+        EVERY replica fails does reload raise — and then the old weights
+        everywhere stay live."""
         if source is None:
             source = self.config.watch_dir
         if source is None:
@@ -438,39 +571,72 @@ class ModelServer:
                 bound = set(self._symbol.list_arguments())
                 arg_params = {k: v for k, v in arg_params.items()
                               if k in bound}
-            # one host→device transfer; the per-bucket swaps below are
-            # then device-side copies into the shared bound arrays
-            arg_params = self._to_ctx(arg_params)
-            aux_params = self._to_ctx(aux_params)
-            with self._batcher.run_lock:
-                # every bucket binds the SAME device arrays (weights were
-                # moved to ctx once at construction, pinned by
-                # test_buckets_share_device_weights), so one set_params
-                # swaps the values every bucket sees; the other buckets
-                # only need their param STORES synced for a later reshape
-                # re-bind — not len(buckets)-1 more full device copies
-                # while the run lock is blocking traffic
-                first, *rest = self._predictors.values()
-                first.set_params(arg_params, aux_params,
-                                 allow_missing=False)
-                for pred in rest:
-                    with pred._lock:
-                        for name in arg_params:
-                            if name in first.arg_params:
-                                pred.arg_params[name] = \
-                                    first.arg_params[name]
-                        for name in aux_params:
-                            if name in first.aux_params:
-                                pred.aux_params[name] = \
-                                    first.aux_params[name]
-                        pred._partial_outs = None
-                self.version += 1
-                if loaded_commit is not None and self._is_watch_dir(source):
-                    self._latest_seen = loaded_commit
+            new_version = self.version + 1
+            ok = 0
+            for rep in self._pool.replicas:
+                try:
+                    self._reload_replica(rep, arg_params, aux_params,
+                                         new_version)
+                except Exception as e:  # noqa: BLE001 — per-replica blast
+                    _tm.counter("serving.reload_error").inc()
+                    self._pool.eject(rep, f"reload failed: {e!r}")
+                    self.logger.exception(
+                        "serving: reload failed on replica %d; replica "
+                        "ejected, pool keeps serving", rep.rid)
+                else:
+                    # a successful swap also heals an ejected/opened
+                    # replica: its weights are now provably consistent
+                    self._pool.heal(rep)
+                    ok += 1
+            if ok == 0:
+                raise MXNetError(
+                    f"reload from {source!r} failed on every replica; "
+                    "previous weights stay live")
+            self.version = new_version
+            if loaded_commit is not None and self._is_watch_dir(source):
+                self._latest_seen = loaded_commit
         _tm.counter("serving.reload").inc()
-        self.logger.info("serving: reloaded weights from %s (version %d)",
-                         source, self.version)
+        self.logger.info(
+            "serving: reloaded weights from %s (version %d, %d/%d "
+            "replicas)", source, self.version, ok,
+            len(self._pool.replicas))
         return self.version
+
+    def _reload_replica(self, rep, arg_params, aux_params, new_version):
+        from .. import faultinject as _fi
+
+        _fi.on_serving_reload(rep.rid)
+        # one host→device transfer per replica; the per-bucket swaps
+        # below are then device-side copies into the shared bound arrays
+        r_args = self._to_ctx(arg_params, rep.ctx)
+        r_aux = self._to_ctx(aux_params, rep.ctx)
+        # a hung forward holds the replica lock — bounded acquire so one
+        # wedged replica cannot poison the whole pool's reload
+        lock_timeout = max(self.config.replica_timeout, 30.0)
+        if not rep.lock.acquire(timeout=lock_timeout):
+            raise MXNetError(
+                f"replica {rep.rid} lock not acquired in "
+                f"{lock_timeout:.0f} s (hung forward?)")
+        try:
+            # every bucket binds the SAME device arrays (weights were
+            # moved to this replica's ctx once at construction, pinned by
+            # test_buckets_share_device_weights), so one set_params swaps
+            # the values every bucket sees; the other buckets only need
+            # their param STORES synced for a later reshape re-bind
+            first, *rest = rep.predictors.values()
+            first.set_params(r_args, r_aux, allow_missing=False)
+            for pred in rest:
+                with pred._lock:
+                    for name in r_args:
+                        if name in first.arg_params:
+                            pred.arg_params[name] = first.arg_params[name]
+                    for name in r_aux:
+                        if name in first.aux_params:
+                            pred.aux_params[name] = first.aux_params[name]
+                    pred._partial_outs = None
+            rep.version = new_version
+        finally:
+            rep.lock.release()
 
     def _symbol_unfolded(self):
         # _fold replaced self._symbol with the folded graph at
@@ -508,10 +674,27 @@ class ModelServer:
 
     # -- introspection -------------------------------------------------
     def stats(self):
-        """Health/inspection payload (the ``/healthz`` body)."""
+        """Health/readiness payload (the ``/healthz`` body). ``status``:
+        ``ok`` (all replicas healthy) / ``degraded`` (some) /
+        ``unavailable`` (none — an external LB should eject this
+        process) / ``warming`` / ``draining``."""
+        reps = self._pool.stats()
+        healthy = sum(1 for r in reps if r["state"] == "closed")
+        if self._closed:
+            status = "draining"
+        elif not self._batcher.running:
+            status = "warming"
+        elif healthy == 0:
+            status = "unavailable"
+        elif healthy < len(reps):
+            status = "degraded"
+        else:
+            status = "ok"
         return {
-            "status": "draining" if self._closed else (
-                "ok" if self._batcher.running else "warming"),
+            "status": status,
+            "degraded": 0 < healthy < len(reps),
+            "healthy_replicas": healthy,
+            "replicas": reps,
             "buckets": list(self.config.buckets),
             "queue_depth": len(self._batcher._queue),
             "queue_limit": self.config.queue_depth,

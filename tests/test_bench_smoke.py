@@ -178,6 +178,44 @@ def test_bench_serve_mode_beats_sequential_and_never_compiles():
         f"{rec['sequential_img_per_sec']} img/s")
 
 
+def test_bench_serve_chaos_availability():
+    """BENCH_CHAOS=1 serve leg: a replica killed under concurrent traffic
+    and later revived must cost availability NOTHING (failover absorbs
+    it) — pinned >= 0.99 per the serving SLO — with the fault window's
+    p99 reported and at least one counted failover re-dispatch."""
+    env = dict(os.environ)
+    clean = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([_ROOT] + clean)
+    env["JAX_PLATFORMS"] = "cpu"
+    # >= 2 virtual devices so the pool has a survivor to fail over to
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    env["BENCH_MODE"] = "serve"
+    env["BENCH_CHAOS"] = "1"
+    env["BENCH_LAYERS"] = "18"
+    env["BENCH_SERVE_BUCKETS"] = "1,4"
+    env["BENCH_SERVE_CLIENTS"] = "4"
+    env["BENCH_SERVE_REQUESTS"] = "6"
+    env["BENCH_SERVE_SEQ_ITERS"] = "2"
+    env["BENCH_SERVE_SCALING"] = "0"  # scaling leg is the TPU round's job
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py")],
+        capture_output=True, text=True, env=env, timeout=900, cwd=_ROOT,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["replicas"] == 2
+    assert rec["errors"] == 0  # the clean measurement phase
+    assert rec["availability"] >= 0.99, rec["chaos"]
+    assert rec["chaos"]["failed"] == 0, rec["chaos"]
+    assert rec["chaos"]["failover_count"] >= 1, (
+        "replica kill never exercised failover")
+    assert rec["p99_during_fault_ms"] > 0
+    # both replicas actually served during the clean phase
+    assert all(v > 0 for v in rec["per_replica_batches"].values()), rec
+
+
 def test_graft_entry_single_chip_compiles():
     """entry() returns a jittable forward; eval_shape validates the trace
     without paying device compile time."""

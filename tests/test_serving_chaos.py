@@ -1,0 +1,533 @@
+"""Serving-path chaos suite (ISSUE 7).
+
+Every claim the replicated serving tier makes is proven here under
+injected faults (``MXNET_FI_SERVE_*``, runtime-togglable), counter-
+verified through ``serving.replica.*``:
+
+- kill a replica under concurrent traffic → ZERO failed client requests
+  (failover re-dispatch absorbs it; only latency moves);
+- all replicas down → fast typed 503-mapped errors, never hangs, within
+  2x the request deadline;
+- the replica recovers → traffic returns through the half-open probe;
+- a hung replica is timed out by the watchdog and the batch fails over;
+- hedging duplicates a slow batch to a second replica;
+- a reload failure on one replica ejects it instead of poisoning the
+  pool;
+- the request path performs ZERO XLA compiles across failover and hedged
+  re-dispatch, and per-bucket outputs are bitwise identical regardless of
+  which replica served the batch;
+- the batcher worker survives unhandled errors (typed failure + restart)
+  and admission degrades proportionally with healthy capacity.
+
+Runs on CPU with virtual devices (conftest forces
+``--xla_force_host_platform_device_count=8``).
+"""
+
+import contextlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faultinject
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import (DynamicBatcher, ModelServer,
+                               NoHealthyReplicas, ServerOverloaded,
+                               ServingConfig, WorkerCrashed)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_serve_faults(monkeypatch):
+    """No serving fault leaks across tests; ordinals rewound."""
+    faultinject.reset()
+    for k in ("MXNET_FI_SERVE_RAISE_REPLICA", "MXNET_FI_SERVE_LATENCY_MS",
+              "MXNET_FI_SERVE_LATENCY_REPLICA", "MXNET_FI_SERVE_FAIL_EVERY",
+              "MXNET_FI_SERVE_RELOAD_CORRUPT"):
+        monkeypatch.delenv(k, raising=False)
+    yield
+    faultinject.reset()
+
+
+def _mlp_params(seed=0, num_classes=4, scale=1.0):
+    from mxnet_tpu import models
+
+    sym = models.mlp(num_classes=num_classes)
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 6), softmax_label=(1,))
+    rng = np.random.RandomState(seed)
+    params = {}
+    for n, s in zip(sym.list_arguments(), arg_shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        params[n] = mx.nd.array(
+            (scale * rng.randn(*s)).astype(np.float32))
+    return sym, params
+
+
+@contextlib.contextmanager
+def _server(replicas=2, buckets=(1, 4), started=True, seed=0, **cfg):
+    cfg.setdefault("max_delay_ms", 1.0)
+    cfg.setdefault("queue_depth", 128)
+    sym, params = _mlp_params(seed=seed)
+    srv = ModelServer(
+        sym, params, {"data": (6,)},
+        config=ServingConfig(buckets=buckets, replicas=replicas, **cfg))
+    try:
+        if started:
+            srv.start()
+        yield srv
+    finally:
+        srv.close()
+
+
+def _x(i=0):
+    rng = np.random.RandomState(100 + i)
+    return rng.uniform(-1, 1, (6,)).astype(np.float32)
+
+
+def _delta(name):
+    c = mx.telemetry.counter(name)
+    v0 = c.value
+    return lambda: c.value - v0
+
+
+def test_replica_pool_construction_and_routing():
+    """Two replicas bind distinct devices, each with the full bucket set
+    sharing device arrays per replica; traffic spreads across both."""
+    with _server(replicas=2, max_delay_ms=0.0) as srv:
+        assert len(srv.replicas) == 2
+        devs = {r.device() for r in srv.replicas}
+        assert len(devs) == 2, f"replicas share a device: {devs}"
+        for rep in srv.replicas:
+            assert sorted(rep.predictors) == [1, 4]
+        # concurrent traffic must actually use both replicas
+        threads = []
+        for i in range(24):
+            t = threading.Thread(
+                target=lambda i=i: srv.predict(_x(i), timeout=30))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        served = [r.batches for r in srv.replicas]
+        assert all(b > 0 for b in served), (
+            f"least-loaded routing starved a replica: {served}")
+        assert mx.telemetry.gauge("serving.replica.healthy").value == 2
+
+
+def test_replica_kill_under_traffic_zero_client_errors(monkeypatch):
+    """Kill replica 0 under >= 32 concurrent in-flight requests: every
+    request completes (failover), the breaker opens, the healthy gauge
+    drops to 1 — zero client-visible errors."""
+    failover = _delta("serving.replica.failover")
+    opened = _delta("serving.replica.open")
+    with _server(replicas=2, cb_probe_ms=60_000) as srv:
+        failures = []
+        done = []  # list.append is atomic; a bare int += would race
+        barrier = threading.Barrier(33)  # 32 clients + the killer
+
+        def client(cid):
+            for i in range(6):
+                try:
+                    out = srv.predict(_x(cid * 7 + i), timeout=60)
+                    assert len(out) > 0
+                    done.append(1)
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    failures.append(repr(e))
+                if i == 1:
+                    barrier.wait(timeout=60)  # all 32 in flight post-kill
+
+        def killer():
+            barrier.wait(timeout=60)
+            monkeypatch.setenv("MXNET_FI_SERVE_RAISE_REPLICA", "0")
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(32)] + [threading.Thread(target=killer)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not failures, failures[:5]
+        assert len(done) == 32 * 6
+        assert failover() >= 1, "no batch ever failed over"
+        assert opened() >= 1, "the dead replica's breaker never opened"
+        assert mx.telemetry.gauge("serving.replica.healthy").value == 1
+        states = {r["id"]: r["state"] for r in srv.stats()["replicas"]}
+        assert states[0] == "open" and states[1] == "closed"
+
+
+def test_all_replicas_down_fast_typed_errors(monkeypatch):
+    """Both replicas dead: after the breakers open, requests fail FAST
+    with the typed 503-mapped error (NoHealthyReplicas) — well under 2x
+    the request deadline, never a hang."""
+    no_cap = _delta("serving.no_capacity")
+    # cb_errors=1: one failure opens a breaker; probe far in the future
+    # so the pool stays provably down for the whole test
+    with _server(replicas=2, cb_errors=1, cb_probe_ms=60_000,
+                 max_delay_ms=0.0) as srv:
+        monkeypatch.setenv("MXNET_FI_SERVE_RAISE_REPLICA", "0,1")
+        # the opening request: tries both replicas, both fail, error
+        # surfaces typed (the injected fault) — and both breakers open
+        with pytest.raises(MXNetError):
+            srv.predict(_x(), timeout=30)
+        assert mx.telemetry.gauge("serving.replica.healthy").value == 0
+        deadline_ms = 250.0
+        for i in range(5):
+            t0 = time.monotonic()
+            with pytest.raises(NoHealthyReplicas):
+                srv.predict(_x(i), timeout=30, deadline_ms=deadline_ms)
+            took = time.monotonic() - t0
+            assert took < 2 * deadline_ms / 1e3, (
+                f"all-down request took {took * 1e3:.0f} ms — not a fast "
+                "typed rejection")
+        assert no_cap() >= 5
+        assert srv.stats()["status"] == "unavailable"
+
+
+def test_replica_recovers_after_half_open_probe(monkeypatch):
+    """Clear the fault → the opened breaker's half-open probe routes one
+    live request through, closes on success, and traffic returns to the
+    recovered replica."""
+    probes = _delta("serving.replica.probe")
+    recovered = _delta("serving.replica.recovered")
+    with _server(replicas=2, cb_probe_ms=40.0, max_delay_ms=0.0) as srv:
+        monkeypatch.setenv("MXNET_FI_SERVE_RAISE_REPLICA", "0")
+        for i in range(6):  # opens replica 0's breaker (3 consec errors)
+            srv.predict(_x(i), timeout=30)
+        assert mx.telemetry.gauge("serving.replica.healthy").value == 1
+        monkeypatch.delenv("MXNET_FI_SERVE_RAISE_REPLICA")
+        served_before = srv.replicas[0].batches
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            srv.predict(_x(1), timeout=30)
+            if (srv.replicas[0].state == "closed"
+                    and srv.replicas[0].batches > served_before):
+                break
+            time.sleep(0.01)
+        assert srv.replicas[0].state == "closed", (
+            "replica 0 never recovered after the fault cleared")
+        assert srv.replicas[0].batches > served_before
+        assert probes() >= 1 and recovered() >= 1
+        assert mx.telemetry.gauge("serving.replica.healthy").value == 2
+        assert srv.stats()["status"] == "ok"
+
+
+def test_watchdog_times_out_hung_replica(monkeypatch):
+    """A hung forward (injected latency >> watchdog) marks the replica
+    suspect and the batch fails over — the dispatch path never freezes
+    and no client request fails."""
+    timeouts = _delta("serving.replica.timeout")
+    with _server(replicas=2, replica_timeout_ms=250.0,
+                 cb_probe_ms=60_000, max_delay_ms=0.0) as srv:
+        monkeypatch.setenv("MXNET_FI_SERVE_LATENCY_MS", "5000")
+        monkeypatch.setenv("MXNET_FI_SERVE_LATENCY_REPLICA", "0")
+        failures = []
+
+        def client(i):
+            try:
+                assert len(srv.predict(_x(i), timeout=60)) > 0
+            except Exception as e:  # noqa: BLE001
+                failures.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        assert not failures, failures
+        assert timeouts() >= 1, "the watchdog never fired"
+        assert srv.replicas[0].state == "open"
+        assert wall < 5.0, (
+            f"requests took {wall:.1f}s — a hung replica froze dispatch")
+
+
+def test_hedged_request_wins_on_second_replica(monkeypatch):
+    """With hedging armed and every forward slowed past the hedge delay,
+    a duplicate dispatch fires on the second replica (first result wins;
+    the loser is discarded, not surfaced)."""
+    hedges = _delta("serving.replica.hedge")
+    with _server(replicas=2, hedge_ms=50.0, max_delay_ms=0.0) as srv:
+        monkeypatch.setenv("MXNET_FI_SERVE_LATENCY_MS", "300")
+        out = srv.predict(_x(), timeout=30)
+        assert len(out) > 0
+        assert hedges() >= 1, "no hedge was dispatched"
+        monkeypatch.delenv("MXNET_FI_SERVE_LATENCY_MS")
+        # pool is fully healthy afterwards: hedging is not an error path
+        assert mx.telemetry.gauge("serving.replica.healthy").value == 2
+
+
+def test_fail_every_nth_batch_is_absorbed(monkeypatch):
+    """Intermittent faults (every 3rd serving batch attempt raises, any
+    replica) are fully absorbed by failover re-dispatch: zero client
+    errors."""
+    failover = _delta("serving.replica.failover")
+    with _server(replicas=2, max_delay_ms=0.0) as srv:
+        monkeypatch.setenv("MXNET_FI_SERVE_FAIL_EVERY", "3")
+        for i in range(30):
+            assert len(srv.predict(_x(i), timeout=30)) > 0
+        assert failover() >= 5  # ~10 injected failures, all re-dispatched
+
+
+def test_reload_failure_ejects_replica_not_pool(monkeypatch):
+    """A reload that fails on replica 1 ejects ONLY replica 1: the pool
+    keeps serving the NEW weights from replica 0, and a later clean
+    reload heals the ejected replica."""
+    ejected = _delta("serving.replica.ejected")
+    reload_err = _delta("serving.reload_error")
+    with _server(replicas=2, max_delay_ms=0.0, seed=3) as srv:
+        from mxnet_tpu.predictor import Predictor
+
+        _, params_v2 = _mlp_params(seed=9, scale=2.0)
+        v2 = {f"arg:{k}": v for k, v in params_v2.items()}
+        monkeypatch.setenv("MXNET_FI_SERVE_RELOAD_CORRUPT", "1")
+        assert srv.reload(v2) == 1
+        assert ejected() == 1 and reload_err() == 1
+        states = {r["id"]: r["state"] for r in srv.stats()["replicas"]}
+        assert states[1] == "ejected" and states[0] == "closed"
+        assert srv.stats()["status"] == "degraded"
+        # traffic still flows, on the NEW weights, bitwise
+        x = _x(5)
+        ref = Predictor(srv._orig_symbol, v2, {"data": (1, 6)})
+        out = srv.predict(x, timeout=30)
+        assert out[0].tobytes() == ref.run(data=x[None])[0][0].tobytes()
+        # an ejected replica is NOT probe-eligible: time alone must never
+        # re-admit weights of unknown consistency
+        time.sleep(0.3)
+        assert srv.replicas[1].state == "ejected"
+        # a clean reload heals it
+        monkeypatch.delenv("MXNET_FI_SERVE_RELOAD_CORRUPT")
+        assert srv.reload(v2) == 2
+        assert srv.replicas[1].state == "closed"
+        assert srv.stats()["status"] == "ok"
+        assert srv.replicas[1].version == 2
+
+
+def test_bitwise_determinism_across_replicas(monkeypatch):
+    """Per-bucket outputs are bitwise identical regardless of which
+    replica served the batch — both driven directly (each replica's
+    bucket-1 program) and through failover routing (the future's
+    stamped replica id proves who served)."""
+    with _server(replicas=2, max_delay_ms=0.0, cb_errors=1,
+                 cb_probe_ms=1.0) as srv:
+        x = _x(7)
+        batch = x[None]
+        direct = [srv.predictor(1, replica=r).run(data=batch)[0]
+                  for r in (0, 1)]
+        assert direct[0].tobytes() == direct[1].tobytes(), (
+            "replica programs disagree bitwise for the same bucket")
+
+        # through traffic: kill 0 → served by 1; kill 1 (0 heals via an
+        # immediate probe) → served by 0
+        monkeypatch.setenv("MXNET_FI_SERVE_RAISE_REPLICA", "0")
+        f1 = srv.submit({"data": x})
+        out1 = f1.result(30)
+        monkeypatch.setenv("MXNET_FI_SERVE_RAISE_REPLICA", "1")
+        deadline = time.monotonic() + 20
+        f2 = srv.submit({"data": x})
+        out2 = f2.result(30)
+        while f2.replica == f1.replica and time.monotonic() < deadline:
+            time.sleep(0.01)
+            f2 = srv.submit({"data": x})
+            out2 = f2.result(30)
+        assert f1.replica != f2.replica, "failover never switched replica"
+        assert out1[0].tobytes() == out2[0].tobytes(), (
+            f"replica {f1.replica} and {f2.replica} responses differ "
+            "bitwise for bucket 1")
+
+
+def test_no_compile_across_failover_and_hedge(monkeypatch):
+    """The warmed request path performs ZERO XLA compiles even while
+    batches fail over and hedge across replicas."""
+    with _server(replicas=2, hedge_ms=20.0, cb_probe_ms=50.0) as srv:
+        compiles = mx.telemetry.counter("executor.jit_compile")
+        aot_trace = mx.telemetry.counter("aot.trace_compile")
+        c0, a0 = compiles.value, aot_trace.value
+        monkeypatch.setenv("MXNET_FI_SERVE_RAISE_REPLICA", "0")
+        threads = [threading.Thread(
+            target=lambda i=i: srv.predict(_x(i), timeout=60))
+            for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        monkeypatch.delenv("MXNET_FI_SERVE_RAISE_REPLICA")
+        for i in range(8):
+            srv.predict(_x(i), timeout=30)
+        assert compiles.value - c0 == 0, (
+            "XLA compile on the failover/hedge path")
+        assert aot_trace.value - a0 == 0
+
+
+def test_worker_crash_fails_pending_typed_and_restarts():
+    """Satellite: an unhandled exception outside the per-batch guard
+    (here: a crashing latency observer) must fail pending futures with
+    the typed WorkerCrashed, count serving.worker_crash, and restart the
+    worker — pounded across several crash/recover cycles."""
+    crashes = _delta("serving.worker_crash")
+    with _server(replicas=1, buckets=(1, 4), max_delay_ms=5.0) as srv:
+        real_observer = srv._batcher._latency_observer
+
+        def bomb(_lat_us):
+            raise RuntimeError("observer exploded")
+
+        for cycle in range(4):
+            srv._batcher._latency_observer = bomb
+            futs = [srv.submit({"data": _x(cycle * 8 + i)})
+                    for i in range(6)]
+            crashed = 0
+            for f in futs:
+                try:
+                    f.result(30)
+                except WorkerCrashed:
+                    crashed += 1
+            assert crashed >= 1, "no future saw the typed crash error"
+            # recover: the restarted worker must serve fresh traffic
+            srv._batcher._latency_observer = real_observer
+            assert len(srv.predict(_x(cycle), timeout=30)) > 0
+        assert crashes() >= 4
+        assert srv._batcher.running
+
+
+def test_admission_scales_with_healthy_capacity():
+    """Graceful degradation: the effective admission bound is
+    queue_depth x healthy fraction — a half-dead pool sheds at half
+    depth with Retry-After semantics instead of deadline-expiring a full
+    queue; zero capacity fails typed."""
+    frac = [1.0]
+    entered = threading.Event()
+    release = threading.Event()
+
+    def runner(bucket, stacked, n_valid):
+        entered.set()
+        assert release.wait(30)
+        return [np.zeros((bucket, 1), np.float32)]
+
+    b = DynamicBatcher(runner, buckets=(1,), max_delay=0.0, queue_depth=8,
+                       capacity_fn=lambda: frac[0])
+    b.start()
+    try:
+        x = {"data": np.zeros((2,), np.float32)}
+        b.submit(dict(x))  # taken by the worker, blocks in runner
+        assert entered.wait(10)
+        for _ in range(4):
+            b.submit(dict(x))  # 4 queued: half of queue_depth
+        frac[0] = 0.5  # half the pool died: effective depth is now 4
+        with pytest.raises(ServerOverloaded):
+            b.submit(dict(x))
+        frac[0] = 1.0  # recovered: full depth admits again
+        b.submit(dict(x))
+        frac[0] = 0.0  # everything died: typed fast rejection
+        with pytest.raises(NoHealthyReplicas):
+            b.submit(dict(x))
+    finally:
+        release.set()
+        b.stop(drain=True)
+
+
+def test_healthz_readiness_degraded_and_unavailable(monkeypatch):
+    """Satellite: /healthz is readiness-aware — 200 + degraded:true with
+    per-replica states while partially healthy, 503 (with body) when no
+    replica is healthy, so an external LB can eject the process."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from mxnet_tpu.serving import make_http_server
+
+    with _server(replicas=2, cb_errors=1, cb_probe_ms=60_000,
+                 max_delay_ms=0.0) as srv:
+        httpd = make_http_server(srv, host="127.0.0.1", port=0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            def healthz():
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/healthz",
+                            timeout=30) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            code, body = healthz()
+            assert code == 200 and body["status"] == "ok"
+            assert body["degraded"] is False
+            assert len(body["replicas"]) == 2
+
+            monkeypatch.setenv("MXNET_FI_SERVE_RAISE_REPLICA", "0")
+            srv.predict(_x(), timeout=30)  # opens replica 0 (cb_errors=1)
+            code, body = healthz()
+            assert code == 200 and body["status"] == "degraded"
+            assert body["degraded"] is True
+            assert body["healthy_replicas"] == 1
+            states = {r["id"]: r["state"] for r in body["replicas"]}
+            assert states[0] == "open"
+
+            monkeypatch.setenv("MXNET_FI_SERVE_RAISE_REPLICA", "0,1")
+            with pytest.raises(Exception):
+                srv.predict(_x(), timeout=30)  # opens replica 1 too
+            code, body = healthz()
+            assert code == 503, "zero healthy replicas must be 503"
+            assert body["status"] == "unavailable"
+            assert body["healthy_replicas"] == 0
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+def test_http_body_size_cap():
+    """Satellite: a POST whose Content-Length exceeds
+    MXNET_SERVING_MAX_BODY_BYTES is refused with 413 before the body is
+    read; fresh connections still serve."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from mxnet_tpu.serving import make_http_server
+
+    with _server(replicas=1, max_body_bytes=2048) as srv:
+        httpd = make_http_server(srv, host="127.0.0.1", port=0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            big = json.dumps(
+                {"inputs": {"data": [0.0] * 4000}}).encode()
+            assert len(big) > 2048
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=big,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 413
+            assert mx.telemetry.counter(
+                "serving.http.body_too_large").value >= 1
+
+            x = _x()
+            body = json.dumps({"inputs": {"data": x.tolist()}}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                payload = json.loads(r.read())
+            assert len(payload["outputs"]) > 0
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+def test_replica_auto_resolution_on_cpu():
+    """replicas=0 (auto) degenerates to ONE replica on CPU — today's
+    single-device behavior — even with virtual devices present; an
+    explicit ask beyond the device count clamps."""
+    with _server(replicas=0, started=False) as srv:
+        assert len(srv.replicas) == 1
+    with _server(replicas=64, started=False) as srv:
+        assert len(srv.replicas) == 8  # conftest forces 8 virtual devices

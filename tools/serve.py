@@ -18,10 +18,12 @@ Stdlib-only CLI over :mod:`mxnet_tpu.serving`. Examples::
         -d '{"inputs": {"data": [[...]]}}'
     curl -s localhost:8080/metrics   # Prometheus text
 
-Pre-compiles every bucket before binding the port (zero request-path
-compiles; set MXNET_AOT_CACHE=1 to persist executables so the NEXT serve
-process warms from disk). SIGINT drains gracefully: queued requests
-complete, new ones are refused.
+Pre-compiles every (replica, bucket) executable before binding the port
+(zero request-path compiles; set MXNET_AOT_CACHE=1 to persist executables
+so the NEXT serve process warms from disk). `--replicas N` (or auto on
+TPU) replicates the model across N devices with health-gated failover —
+see docs/serving.md "Failure semantics". SIGINT drains gracefully:
+queued requests complete, new ones are refused.
 """
 
 from __future__ import annotations
@@ -81,6 +83,24 @@ def main(argv=None):
     ap.add_argument("--max-delay-ms", type=float, default=None)
     ap.add_argument("--queue-depth", type=int, default=None)
     ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="model replicas, one per device (0 = auto: all "
+                         "local accelerator devices on TPU, 1 on CPU; "
+                         "default $MXNET_SERVING_REPLICAS)")
+    ap.add_argument("--replica-timeout-ms", type=float, default=None,
+                    help="per-batch execution watchdog; a hung replica "
+                         "call fails over instead of freezing dispatch "
+                         "(default $MXNET_SERVING_REPLICA_TIMEOUT_MS)")
+    ap.add_argument("--max-retries", type=int, default=None,
+                    help="failover re-dispatches of a failed batch "
+                         "(default $MXNET_SERVING_MAX_RETRIES)")
+    ap.add_argument("--hedge-ms", type=float, default=None,
+                    help="duplicate a slow batch to a second replica "
+                         "after this delay; first result wins (default "
+                         "$MXNET_SERVING_HEDGE_MS, 0 = off)")
+    ap.add_argument("--max-body-bytes", type=int, default=None,
+                    help="reject request bodies larger than this with "
+                         "413 (default $MXNET_SERVING_MAX_BODY_BYTES)")
     ap.add_argument("--watch", type=float, default=None,
                     help="poll --checkpoint-dir every N seconds for new "
                          "checkpoints (default $MXNET_SERVING_WATCH)")
@@ -115,7 +135,10 @@ def main(argv=None):
         buckets=args.buckets, max_delay_ms=args.max_delay_ms,
         queue_depth=args.queue_depth, deadline_ms=args.deadline_ms,
         watch_dir=args.checkpoint_dir, watch_period=args.watch,
-        fold_bn=not args.no_fold_bn)
+        fold_bn=not args.no_fold_bn, replicas=args.replicas,
+        replica_timeout_ms=args.replica_timeout_ms,
+        max_retries=args.max_retries, hedge_ms=args.hedge_ms,
+        max_body_bytes=args.max_body_bytes)
     server = ModelServer(
         symbol, params, dict(args.input), config=config,
         dev_type=args.dev_type, dev_id=args.dev_id,
