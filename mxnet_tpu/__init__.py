@@ -13,13 +13,13 @@ def _maybe_init_distributed():
     package import — the analogue of the reference auto-entering the server
     loop on import when DMLC_ROLE=server (python/mxnet/kvstore_server.py:58).
     """
-    import os
-
     from . import env  # stdlib-only; safe before jax
 
     coord = env.get("MXNET_COORDINATOR")
     nproc = env.get("MXNET_NUM_PROCS")
-    if coord and nproc > 1 and "MXNET_PROC_ID" in os.environ:
+    # raw(): rank 0 unset vs rank 0 exported are different cases — only a
+    # launcher-exported rank means this process belongs to a multi-host job
+    if coord and nproc > 1 and env.raw("MXNET_PROC_ID") is not None:
         import jax
 
         try:

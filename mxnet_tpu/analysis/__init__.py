@@ -1,0 +1,34 @@
+"""graftlint — framework-invariant static analysis (stdlib ``ast`` only).
+
+The framework's hardest-won invariants are dynamic-test-shaped today: the
+zero-host-sync fit/serve hot paths are counter-verified on the specific
+paths the tests drive, trace purity is enforced by nothing but review, and
+the env/telemetry catalogues drift silently. This package checks them at
+the call site they are introduced, across every path, without running a
+chip:
+
+- ``host-sync``      — blocking device→host syncs inside declared hot paths
+- ``trace-purity``   — impure host effects inside code captured by
+                       ``jax.jit`` / ``lax.fori_loop`` / ``lax.scan``
+- ``env-registry``   — every ``MXNET_*`` environ read routes through
+                       :mod:`mxnet_tpu.env`; registry and docs stay in sync
+- ``telemetry-catalog`` — instrument names are literal, follow the
+                       ``sub.system.name`` convention and are documented
+- ``lock-discipline`` — lock-order cycles, mixed guarded/unguarded field
+                       mutation, blocking work under the batcher run lock
+- ``typos``          — transcription tells (known-typo identifier list)
+
+Suppression: ``# graftlint: allow=<check>(<reason>)`` — file-wide on a
+comment-only line, single-line as a trailing comment. Grandfathered
+findings live in ``tools/lint_baseline.json``; ``tools/lint.py`` is the
+CLI and ``tests/test_lint.py`` holds the tree at zero new findings.
+
+This package is deliberately self-contained (relative imports, stdlib
+only) so ``tools/lint.py`` can load it without importing the framework —
+linting must not require a working jax install.
+"""
+
+from .core import (  # noqa: F401
+    Finding, LintResult, SourceUnit, all_checkers, checker_names,
+    load_baseline, run_suite, write_baseline,
+)

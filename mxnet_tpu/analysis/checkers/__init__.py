@@ -1,0 +1,21 @@
+"""graftlint checkers. Each checker is a class with a ``name``, a
+``doc`` one-liner (rendered into docs/lint.md's catalog) and a
+``run(ctx) -> iterable[Finding]`` over the whole tree context."""
+
+from .env_registry import EnvRegistryChecker
+from .host_sync import HostSyncChecker
+from .lock_discipline import LockDisciplineChecker
+from .telemetry_catalog import TelemetryCatalogChecker
+from .trace_purity import TracePurityChecker
+from .typos import TyposChecker
+
+ALL_CHECKERS = [
+    HostSyncChecker,
+    TracePurityChecker,
+    EnvRegistryChecker,
+    TelemetryCatalogChecker,
+    LockDisciplineChecker,
+    TyposChecker,
+]
+
+__all__ = ["ALL_CHECKERS"]

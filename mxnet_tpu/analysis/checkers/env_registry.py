@@ -1,0 +1,146 @@
+"""env-registry: every MXNET_* environ read routes through mxnet_tpu.env.
+
+:mod:`mxnet_tpu.env` exists so the variable catalogue can never drift from
+the implementation (SURVEY §5) — which only holds if nothing reads
+``os.environ`` behind its back. This checker enforces, tree-wide:
+
+- no raw ``os.environ`` / ``os.getenv`` access to an ``MXNET_*`` name
+  outside ``mxnet_tpu/env.py`` (reads AND writes; a write that skips the
+  registry is how two modules end up disagreeing about a default);
+- dynamic keys are flagged too — an unauditable read defeats the point;
+- every ``env.get("NAME")`` names a declared variable (otherwise it is a
+  latent ``KeyError``);
+- no variable is declared twice in the registry;
+- the registry and ``docs/env_var.md`` agree in both directions (every
+  declared var has a doc row, every doc row is still declared).
+
+Non-MXNET environs (``JAX_*``, ``PALLAS_*``, CI plumbing) are outside the
+registry's jurisdiction and ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, dotted, enclosing_context, ctx_of, str_const
+
+_ENV_MODULE = "mxnet_tpu/env.py"
+_DOC = "docs/env_var.md"
+
+
+def declared_vars(ctx):
+    """(ordered names, duplicate findings) parsed from env.py's
+    ``_declare(...)`` calls — AST-parsed, never imported, so the linter
+    works without a jax install."""
+    unit = ctx.unit(_ENV_MODULE)
+    names, dupes = [], []
+    if unit is None or unit.tree is None:
+        return names, dupes
+    seen = set()
+    for node in ast.walk(unit.tree):
+        if isinstance(node, ast.Call) and dotted(node.func) == "_declare" \
+                and node.args:
+            name = str_const(node.args[0])
+            if name is None:
+                continue
+            if name in seen:
+                dupes.append(Finding(
+                    "env-registry", unit.path, node.lineno,
+                    f"variable {name} declared twice in the registry"))
+            seen.add(name)
+            names.append(name)
+    return names, dupes
+
+
+class EnvRegistryChecker:
+    name = "env-registry"
+    doc = ("raw `MXNET_*` environ reads outside the typed registry "
+           "(`mxnet_tpu/env.py`), undeclared `env.get` names, duplicate "
+           "declarations, and registry↔`docs/env_var.md` drift")
+
+    def run(self, ctx):
+        declared, dupes = declared_vars(ctx)
+        yield from dupes
+        declared_set = set(declared)
+
+        for unit in ctx.units:
+            if unit.tree is None or unit.path == _ENV_MODULE:
+                continue
+            spans = enclosing_context(unit.tree)
+            for node in ast.walk(unit.tree):
+                yield from self._check_node(unit, spans, node, declared_set)
+
+        yield from self._check_doc(ctx, declared)
+
+    def _check_node(self, unit, spans, node, declared_set):
+        qual = lambda n: ctx_of(spans, n.lineno)  # noqa: E731
+
+        if isinstance(node, ast.Call):
+            callee = dotted(node.func)
+            if callee in ("os.environ.get", "os.getenv", "os.environ.pop",
+                          "os.environ.setdefault"):
+                key = str_const(node.args[0]) if node.args else None
+                yield from self._raw_access(unit, node, qual(node), key,
+                                            f"`{callee}(...)`")
+            elif callee in ("env.get", "_env.get", "env.raw",
+                            "_env.raw") and node.args:
+                key = str_const(node.args[0])
+                if key is not None and declared_set \
+                        and key not in declared_set:
+                    yield Finding(
+                        self.name, unit.path, node.lineno,
+                        f"env.get({key!r}) reads an undeclared variable "
+                        "— declare it in mxnet_tpu/env.py first",
+                        context=qual(node))
+        elif isinstance(node, ast.Subscript) \
+                and dotted(node.value) == "os.environ":
+            key = str_const(node.slice)
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            kind = "`os.environ[...]` write" if write \
+                else "`os.environ[...]` read"
+            yield from self._raw_access(unit, node, qual(node), key, kind,
+                                        write=write)
+        elif isinstance(node, ast.Compare) \
+                and any(isinstance(op, (ast.In, ast.NotIn))
+                        for op in node.ops) \
+                and any(dotted(c) == "os.environ"
+                        for c in node.comparators):
+            key = str_const(node.left)
+            yield from self._raw_access(unit, node, qual(node), key,
+                                        "`in os.environ` membership test")
+
+    def _raw_access(self, unit, node, qual, key, kind, write=False):
+        if key is None:
+            yield Finding(
+                self.name, unit.path, node.lineno,
+                f"{kind} with a dynamic key cannot be audited against the "
+                "registry — route through mxnet_tpu.env",
+                context=qual)
+        elif key.startswith("MXNET_"):
+            fix = ("declare it and write through a registry-aware helper"
+                   if write else "use env.get / env.raw")
+            yield Finding(
+                self.name, unit.path, node.lineno,
+                f"raw {kind} of {key} bypasses the typed registry — {fix}",
+                context=qual)
+
+    def _check_doc(self, ctx, declared):
+        text = ctx.doc_text(_DOC)
+        if text is None or not declared:
+            return  # fixture tree without docs: nothing to cross-check
+        doc_rows = re.findall(r"^\|\s*(MXNET_\w+)\s*\|", text, re.M)
+        doc_set = set(doc_rows)
+        for name in declared:
+            if name not in doc_set:
+                yield Finding(
+                    self.name, _DOC, 0,
+                    f"declared variable {name} has no row in {_DOC} — "
+                    "regenerate the doc (mx.env.document())")
+        declared_set = set(declared)
+        for row in doc_rows:
+            if row not in declared_set:
+                yield Finding(
+                    self.name, _DOC, 0,
+                    f"doc row {row} is not declared in the registry — "
+                    "stale doc or missing declaration")

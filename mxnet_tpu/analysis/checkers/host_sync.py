@@ -1,0 +1,109 @@
+"""host-sync: no blocking device→host syncs inside declared hot paths.
+
+PR 1 made ``Module.fit``/``score`` run with zero per-batch host syncs and
+PR 5/7 extended the contract to the serving request path; the runtime
+counter tests verify it on the paths they drive. This checker enforces it
+lexically on every path: inside a *declared hot-path function* any call to
+``asnumpy`` / ``wait_to_read`` / ``block_until_ready`` / ``.item()`` or
+``np.asarray(...)`` (a disguised d2h copy when handed an NDArray) is a
+finding.
+
+Hot paths are declared two ways:
+
+- the :data:`HOT_PATHS` table below — path -> set of function qualnames
+  (the fit/score epoch loops, the prefetch staging thread, the serving
+  batcher/replica dispatch chain, bench's timed step loop);
+- a ``# graftlint: hotpath`` marker comment on (or directly above) any
+  ``def`` — how new hot paths opt in without touching this file.
+
+A *deliberate* sync (an epoch-boundary drain, bench's fence) carries a
+line pragma with its reason — the point is that every sync on a hot path
+is either a bug or an explained decision.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, dotted, iter_defs
+
+#: repo-relative path -> hot function qualnames in that file.
+HOT_PATHS = {
+    "mxnet_tpu/module/base_module.py": {
+        "BaseModule.fit", "BaseModule.score", "BaseModule.forward_backward",
+    },
+    "mxnet_tpu/module/module.py": {
+        "Module.forward", "Module.backward", "Module.update",
+        "Module.train_window", "Module.update_metric",
+    },
+    "mxnet_tpu/io.py": {
+        "DevicePrefetchIter.next", "DevicePrefetchIter.iter_next",
+        "DevicePrefetchIter._worker", "DevicePrefetchIter._stage",
+        "DevicePrefetchIter._put",
+    },
+    "mxnet_tpu/serving/batcher.py": {
+        "DynamicBatcher.submit", "DynamicBatcher._take",
+        "DynamicBatcher._run", "DynamicBatcher._run_batch",
+        "DynamicBatcher._dispatch_task",
+        "DynamicBatcher._execute_and_scatter",
+    },
+    "mxnet_tpu/serving/replica.py": {
+        "Replica.submit", "Replica._call", "ReplicaPool.run_batch",
+        "ReplicaPool._submit", "ReplicaPool._execute",
+    },
+    "mxnet_tpu/serving/server.py": {
+        "ModelServer.submit", "ModelServer.predict", "ModelServer._infer",
+        "ModelServer._coerce",
+    },
+    "bench.py": {
+        "main.run_steps",
+    },
+}
+
+_SYNC_ATTRS = {"asnumpy", "wait_to_read", "block_until_ready", "item"}
+
+
+class HostSyncChecker:
+    name = "host-sync"
+    doc = ("blocking device→host syncs (`asnumpy`/`wait_to_read`/"
+           "`block_until_ready`/`.item()`/`np.asarray`) inside declared "
+           "hot-path functions")
+
+    def run(self, ctx):
+        for unit in ctx.units:
+            if unit.tree is None:
+                continue
+            declared = HOT_PATHS.get(unit.path, set())
+            for qual, _cls, fn in iter_defs(unit.tree):
+                if qual in declared or self._marked(unit, fn):
+                    yield from self._check_fn(unit, qual, fn)
+
+    @staticmethod
+    def _marked(unit, fn):
+        # marker on the def line, or on the line directly above it
+        deco_top = min([fn.lineno]
+                       + [d.lineno for d in fn.decorator_list])
+        return (fn.lineno in unit.hotpath_lines
+                or deco_top - 1 in unit.hotpath_lines)
+
+    def _check_fn(self, unit, qual, fn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_ATTRS:
+                yield Finding(
+                    self.name, unit.path, node.lineno,
+                    f"blocking host sync `.{node.func.attr}()` inside "
+                    "hot path — keep device work async or pragma the "
+                    "deliberate fence",
+                    context=qual)
+            elif callee in ("np.asarray", "numpy.asarray", "np.array",
+                            "numpy.array"):
+                yield Finding(
+                    self.name, unit.path, node.lineno,
+                    f"`{callee}(...)` inside hot path is a device→host "
+                    "copy when handed an NDArray — stage on device or "
+                    "pragma the deliberate fetch",
+                    context=qual)

@@ -1,0 +1,361 @@
+"""lock-discipline: RacerD-flavoured lock-set analysis for the threaded
+subsystems (serving/*, kvstore*, checkpoint).
+
+The replicated serving stack is a web of locks — the batcher's condition
+and run lock, per-replica locks, the pool health lock — kept deadlock-free
+today by convention and the chaos suite. This checker makes the convention
+mechanical. Per scoped file it discovers lock attributes
+(``self.x = threading.Lock()/RLock()/Condition()/Semaphore()`` and
+module-level equivalents), computes per-method lock sets from ``with``
+regions and ``.acquire()`` calls, resolves same-class method calls made
+while holding a lock, and reports:
+
+- **acquisition-order cycles** in the resulting lock graph (lock L taken
+  while holding M somewhere, M while holding L elsewhere — the classic
+  ABBA deadlock), including re-acquiring a non-reentrant ``Lock`` under
+  itself;
+- **mixed guarded/unguarded mutation**: a field written both under a lock
+  and outside any lock (outside ``__init__``) — either the lock is
+  unnecessary or the unguarded write is a race;
+- **blocking work under the batcher run lock**: device calls
+  (``forward``/``run``/``asnumpy``/``wait_to_read``/``block_until_ready``)
+  or future resolution (``set_result``/``set_exception``) while holding a
+  lock named ``run_lock`` — the single-worker serving loop stalls every
+  queued request for the duration.
+
+Lock identity is ``Class.attr`` for ``self`` locks and module-qualified
+for globals; a lock attribute seen on a foreign receiver (``rep.lock``)
+resolves to the unique scoped class declaring that attribute when there
+is exactly one.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, dotted, root_name
+
+_SCOPE_PREFIXES = ("mxnet_tpu/serving/",)
+_SCOPE_FILES = ("mxnet_tpu/kvstore.py", "mxnet_tpu/kvstore_async.py",
+                "mxnet_tpu/checkpoint.py")
+
+_LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_BLOCKING_ATTRS = {"forward", "run", "asnumpy", "wait_to_read",
+                   "block_until_ready"}
+_FUTURE_ATTRS = {"set_result", "set_exception"}
+_SKIP_METHODS = {"__init__", "__del__"}
+
+
+def in_scope(path):
+    if path.startswith(_SCOPE_PREFIXES) or path in _SCOPE_FILES:
+        return True
+    # out-of-tree files (explicit CLI paths, checker fixtures) are always
+    # fair game; inside the framework scope the subsystem list above is
+    # authoritative — single-threaded modules would only produce noise
+    return not path.startswith(("mxnet_tpu/", "bench.py"))
+
+
+def _lock_ctor(value):
+    """'Lock'/'RLock'/... when ``value`` constructs a threading primitive."""
+    if isinstance(value, ast.Call):
+        callee = dotted(value.func) or ""
+        tail = callee.rsplit(".", 1)[-1]
+        if tail in _LOCK_TYPES and (callee.startswith("threading.")
+                                    or callee == tail):
+            return tail
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, module, name, node):
+        self.module = module
+        self.name = name
+        self.node = node
+        self.locks = {}        # attr -> lock type name
+        self.method_locks = {}  # method name -> set of lock node ids
+        self.guarded_writes = {}    # field -> first (line,)
+        self.unguarded_writes = {}  # field -> first (line, method)
+
+    def lock_id(self, attr):
+        return f"{self.name}.{attr}"
+
+
+class LockDisciplineChecker:
+    name = "lock-discipline"
+    doc = ("lock-acquisition-order cycles across serving/kvstore/"
+           "checkpoint, fields mutated both under and outside a lock, "
+           "and blocking device calls or future resolution while holding "
+           "the batcher run lock")
+
+    def run(self, ctx):
+        classes = []       # all _ClassInfo across scoped files
+        edges = {}         # lock id -> {held-> set of (unit, line)}
+        findings = []
+        per_unit = []
+        for unit in ctx.units:
+            if unit.tree is None or not in_scope(unit.path):
+                continue
+            infos = self._collect_classes(unit)
+            classes.extend((unit, info) for info in infos)
+            per_unit.append((unit, infos))
+
+        # attr -> classes declaring it (for foreign-receiver resolution)
+        attr_owner = {}
+        for _unit, info in classes:
+            for attr in info.locks:
+                attr_owner.setdefault(attr, []).append(info)
+
+        for unit, infos in per_unit:
+            for info in infos:
+                self._analyze_class(unit, info, attr_owner, edges, findings)
+
+        findings.extend(self._cycles(edges, classes))
+        return findings
+
+    # -- discovery -----------------------------------------------------
+    def _collect_classes(self, unit):
+        infos = []
+        for node in unit.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = _ClassInfo(unit.path, node.name, node)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    t = sub.targets[0]
+                    kind = _lock_ctor(sub.value)
+                    if kind and isinstance(t, ast.Attribute) \
+                            and root_name(t) == "self":
+                        info.locks[t.attr] = kind
+            infos.append(info)
+        return infos
+
+    # -- per-class analysis --------------------------------------------
+    def _analyze_class(self, unit, info, attr_owner, edges, findings):
+        methods = [n for n in info.node.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        # first pass: lock sets per method (locks it takes at any depth,
+        # including through same-class calls). Iterated to a fixpoint so
+        # an unlocked delegating method defined BEFORE its locking callee
+        # still imports the callee's locks — definition order must not
+        # decide whether a cycle is visible.
+        while True:
+            changed = False
+            for m in methods:
+                taken = set()
+                self._walk(unit, info, attr_owner, m, m.body, [], taken,
+                           None, None)
+                if taken != info.method_locks.get(m.name):
+                    info.method_locks[m.name] = taken
+                    changed = True
+            if not changed:
+                break
+        # second pass: edges + mutations + run-lock rule, with held sets
+        for m in methods:
+            self._walk(unit, info, attr_owner, m, m.body, [], None,
+                       edges, findings)
+        # mixed guarded/unguarded mutation
+        for field_name, (g_line,) in sorted(info.guarded_writes.items()):
+            if field_name in info.unguarded_writes:
+                u_line, u_method = info.unguarded_writes[field_name]
+                findings.append(Finding(
+                    self.name, unit.path, u_line,
+                    f"field `self.{field_name}` of {info.name} is written "
+                    f"both under a lock (line {g_line}) and outside any "
+                    "lock — either drop the lock or guard this write",
+                    context=f"{info.name}.{u_method}"))
+
+    def _resolve_lock(self, info, attr_owner, node):
+        """A lock node id for an expression that names a lock, or None."""
+        if not isinstance(node, ast.Attribute):
+            return None
+        base = root_name(node)
+        attr = node.attr
+        if base == "self":
+            if attr in info.locks:
+                return info.lock_id(attr)
+            return None
+        owners = attr_owner.get(attr, [])
+        if len(owners) == 1:
+            return owners[0].lock_id(attr)
+        if owners:
+            return f"*.{attr}"
+        return None
+
+    def _lock_kind(self, lock_id, attr_owner):
+        cls, _, attr = lock_id.partition(".")
+        for owners in attr_owner.values():
+            for info in owners:
+                if info.name == cls and attr in info.locks:
+                    return info.locks[attr]
+        return None
+
+    def _walk(self, unit, info, attr_owner, method, body, held, taken,
+              edges, findings):
+        """One traversal serving both passes: ``taken`` collects this
+        method's lock set (pass 1); ``edges``/``findings`` record order
+        edges, run-lock violations and writes (pass 2)."""
+        for stmt in body:
+            if isinstance(stmt, ast.With):
+                inner = list(held)
+                for item in stmt.items:
+                    lock = self._resolve_lock(info, attr_owner,
+                                              item.context_expr)
+                    if lock is None:
+                        continue
+                    self._note_acquire(unit, info, attr_owner, stmt, lock,
+                                       inner, taken, edges, findings)
+                    inner = inner + [lock]
+                self._walk(unit, info, attr_owner, method, stmt.body,
+                           inner, taken, edges, findings)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def does not run here; analyze it lock-free
+                self._walk(unit, info, attr_owner, method, stmt.body,
+                           [], taken, edges, findings)
+                continue
+            for node in self._shallow_walk(stmt):
+                if isinstance(node, ast.Call):
+                    self._check_call(unit, info, attr_owner, method, node,
+                                     held, taken, edges, findings)
+                elif findings is not None and isinstance(
+                        node, (ast.Assign, ast.AugAssign)):
+                    self._note_write(info, method, node, held)
+            for attr_name in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr_name, None)
+                if sub and isinstance(sub, list) \
+                        and not isinstance(stmt, ast.With):
+                    self._walk(unit, info, attr_owner, method, sub, held,
+                               taken, edges, findings)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._walk(unit, info, attr_owner, method, handler.body,
+                           held, taken, edges, findings)
+
+    @staticmethod
+    def _shallow_walk(stmt):
+        """Expression-level nodes of ``stmt`` without descending into its
+        statement blocks (those are walked with the right held set)."""
+        blocks = set()
+        for attr_name in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr_name, None)
+            if isinstance(sub, list):
+                for s in sub:
+                    blocks.add(id(s))
+        for handler in getattr(stmt, "handlers", []) or []:
+            blocks.add(id(handler))
+
+        stack = [stmt]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if id(child) in blocks:
+                    continue
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                stack.append(child)
+
+    def _note_acquire(self, unit, info, attr_owner, node, lock, held,
+                      taken, edges, findings):
+        if taken is not None:
+            taken.add(lock)
+        if edges is None:
+            return
+        for h in held:
+            if h == lock:
+                kind = self._lock_kind(lock, attr_owner)
+                if kind in ("Lock", "Semaphore", "BoundedSemaphore"):
+                    findings.append(Finding(
+                        self.name, unit.path, node.lineno,
+                        f"non-reentrant {kind} `{lock}` re-acquired while "
+                        "already held — self-deadlock",
+                        context=f"{info.name}"))
+                continue
+            edges.setdefault(h, {}).setdefault(lock, []).append(
+                (unit.path, node.lineno))
+
+    def _check_call(self, unit, info, attr_owner, method, node, held,
+                    taken, edges, findings):
+        callee = dotted(node.func)
+        # explicit .acquire() — an acquisition event (held-for-region
+        # tracking is not attempted; the order edge is what matters)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "acquire":
+            lock = self._resolve_lock(info, attr_owner, node.func.value)
+            if lock is not None:
+                self._note_acquire(unit, info, attr_owner, node, lock,
+                                   held, taken, edges, findings)
+            return
+        # same-class method call while holding: import its lock set
+        if callee and callee.startswith("self.") and "." not in callee[5:]:
+            target = callee[5:]
+            for lock in sorted(info.method_locks.get(target, ())):
+                self._note_acquire(unit, info, attr_owner, node, lock,
+                                   held, taken, edges, findings)
+        if findings is None or not held:
+            return
+        # blocking work under the batcher run lock
+        if any(h.endswith(".run_lock") for h in held) \
+                and isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _BLOCKING_ATTRS:
+                findings.append(Finding(
+                    self.name, unit.path, node.lineno,
+                    f"blocking device call `.{attr}(...)` while holding "
+                    "the batcher run lock stalls every queued request",
+                    context=f"{info.name}.{method.name}"))
+            elif attr in _FUTURE_ATTRS:
+                findings.append(Finding(
+                    self.name, unit.path, node.lineno,
+                    f"`.{attr}(...)` while holding the batcher run lock — "
+                    "client callbacks run under the lock (resolve futures "
+                    "after releasing it)",
+                    context=f"{info.name}.{method.name}"))
+
+    def _note_write(self, info, method, node, held):
+        if method.name in _SKIP_METHODS:
+            return
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and root_name(t) == "self" \
+                    and isinstance(t.value, ast.Name):
+                field_name = t.attr
+                if held:
+                    info.guarded_writes.setdefault(
+                        field_name, (node.lineno,))
+                else:
+                    info.unguarded_writes.setdefault(
+                        field_name, (node.lineno, method.name))
+
+    # -- cycles --------------------------------------------------------
+    def _cycles(self, edges, classes):
+        findings = []
+        seen_cycles = set()
+
+        def dfs(start, node, path, visited):
+            for nxt in sorted(edges.get(node, {})):
+                if nxt == start and len(path) > 1:
+                    cyc = frozenset(path)
+                    if cyc not in seen_cycles:
+                        seen_cycles.add(cyc)
+                        sites = []
+                        ordered = path + [start]
+                        for a, b in zip(ordered, ordered[1:]):
+                            p, ln = edges[a][b][0]
+                            sites.append(f"{a}->{b} at {p}:{ln}")
+                        p0, l0 = edges[path[0]][path[1]][0] \
+                            if len(path) > 1 else edges[start][start][0]
+                        findings.append(Finding(
+                            self.name, p0, l0,
+                            "lock acquisition-order cycle: "
+                            + " ; ".join(sites),
+                            context="<lock-graph>"))
+                elif nxt not in visited and nxt != start:
+                    dfs(start, nxt, path + [nxt], visited | {nxt})
+
+        for start in sorted(edges):
+            dfs(start, start, [start], {start})
+        return findings

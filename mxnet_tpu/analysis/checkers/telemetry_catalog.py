@@ -1,0 +1,115 @@
+"""telemetry-catalog: instrument names are literal, conventional, documented.
+
+The PR-2 telemetry registry creates instruments on first use — nothing
+stops a call site minting ``fit.batchs`` next to ``fit.batches`` or an
+f-string minting one instrument per request id (an unbounded registry and
+an unreadable dashboard). This checker pins the catalogue:
+
+- the first argument of ``counter``/``gauge``/``histogram``/``span`` must
+  be a string literal (dynamic names are flagged — if a family of names
+  is genuinely needed, enumerate the literals behind a dispatch table and
+  pragma the site with the reason);
+- literal names follow the ``sub.system.name`` convention
+  (lowercase ``[a-z0-9_]`` segments, at least one dot);
+- every literal name appears in ``docs/observability.md``'s instrument
+  catalog (backtick-quoted), so the doc IS the catalogue.
+
+``mxnet_tpu/telemetry.py`` itself is exempt — it is the registry
+implementation and forwards caller-supplied names.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, dotted, enclosing_context, ctx_of, str_const
+
+_DOC = "docs/observability.md"
+_INSTRUMENTS = {"counter", "gauge", "histogram", "span"}
+_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+_EXEMPT = ("mxnet_tpu/telemetry.py", "mxnet_tpu/analysis/")
+
+
+def _telemetry_aliases(tree):
+    """Names this module binds to the telemetry module / its factories."""
+    mod_aliases, fn_aliases = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("telemetry"):
+                    mod_aliases.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.endswith("telemetry"):
+                for a in node.names:
+                    if a.name in _INSTRUMENTS:
+                        fn_aliases.add(a.asname or a.name)
+            else:
+                for a in node.names:
+                    if a.name == "telemetry":
+                        mod_aliases.add(a.asname or a.name)
+    return mod_aliases, fn_aliases
+
+
+class TelemetryCatalogChecker:
+    name = "telemetry-catalog"
+    doc = ("instrument names passed to counter/gauge/histogram/span: "
+           "literal, `sub.system.name`-shaped, and present in "
+           "`docs/observability.md`; dynamic names flagged")
+
+    def run(self, ctx):
+        doc_text = ctx.doc_text(_DOC)
+        for unit in ctx.units:
+            if unit.tree is None or unit.path.startswith(_EXEMPT):
+                continue
+            mod_aliases, fn_aliases = _telemetry_aliases(unit.tree)
+            if not mod_aliases and not fn_aliases:
+                continue
+            spans = enclosing_context(unit.tree)
+            for node in ast.walk(unit.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not self._is_instrument_call(node, mod_aliases,
+                                                fn_aliases):
+                    continue
+                yield from self._check_call(unit, spans, node, doc_text)
+
+    @staticmethod
+    def _is_instrument_call(node, mod_aliases, fn_aliases):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _INSTRUMENTS:
+            base = dotted(f.value)
+            return base in mod_aliases
+        if isinstance(f, ast.Name):
+            return f.id in fn_aliases
+        return False
+
+    def _check_call(self, unit, spans, node, doc_text):
+        qual = ctx_of(spans, node.lineno)
+        if not node.args:
+            return
+        name = str_const(node.args[0])
+        instrument = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else node.func.id
+        if name is None:
+            yield Finding(
+                self.name, unit.path, node.lineno,
+                f"dynamic instrument name passed to {instrument}() — "
+                "enumerate literal names (unbounded registries and "
+                "uncatalogued metrics are unqueryable)",
+                context=qual)
+            return
+        if not _NAME_RE.match(name):
+            yield Finding(
+                self.name, unit.path, node.lineno,
+                f"instrument name {name!r} does not follow the "
+                "`sub.system.name` convention (lowercase dotted segments)",
+                context=qual)
+            return
+        if doc_text is not None and f"`{name}`" not in doc_text:
+            yield Finding(
+                self.name, unit.path, node.lineno,
+                f"instrument `{name}` is missing from {_DOC}'s catalog — "
+                "document it (the doc is the catalogue)",
+                context=qual)

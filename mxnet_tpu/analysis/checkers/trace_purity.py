@@ -1,0 +1,212 @@
+"""trace-purity: no host effects inside code captured into XLA programs.
+
+The whole-graph-to-one-computation design (PAPER §2.2) means the function
+handed to ``jax.jit`` — and the bodies handed to ``lax.fori_loop`` /
+``lax.scan`` / ``lax.while_loop`` (the training-window carries) — executes
+ONCE at trace time and never again. A ``time.time()`` there freezes one
+wall-clock into the compiled program; a ``random.random()`` bakes one
+draw; an ``os.environ`` / ``env.get`` read pins config at trace time while
+looking runtime-dynamic; telemetry/print/logging fire once per compile
+(or per recompile — a classic "my counter only moves when it recompiles"
+bug); and mutating closed-over state from inside a traced body is the
+textbook tracer leak.
+
+Traced functions are found structurally, no decorator convention needed:
+
+- ``def`` decorated with ``jit`` / ``jax.jit`` / ``partial(jax.jit, ...)``
+- functions (by name or inline ``lambda``) passed to calls whose callee
+  ends in ``jit``, ``pmap``, ``fori_loop``, ``scan`` or ``while_loop``
+- every ``def`` nested inside a traced function (closures trace too)
+
+``jax.random.*`` is of course allowed — only stdlib ``random`` and
+``np.random`` are flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import (Finding, ctx_of, dotted, enclosing_context, iter_defs,
+                    local_names, root_name)
+
+_BODY_ARG = {  # callee suffix -> positions of traced-function arguments
+    "jit": (0,),
+    "pmap": (0,),
+    "fori_loop": (2,),
+    "scan": (0,),
+    "while_loop": (0, 1),
+}
+
+_LOG_ROOTS = {"logging", "logger", "_LOG", "_log", "log"}
+_TELEMETRY_ROOTS = {"telemetry", "_tm", "tm", "_telemetry"}
+
+
+def _traced_arg_positions(call):
+    callee = dotted(call.func)
+    if callee is None:
+        return ()
+    tail = callee.rsplit(".", 1)[-1]
+    return _BODY_ARG.get(tail, ())
+
+
+def _jit_decorated(fn):
+    for d in fn.decorator_list:
+        name = dotted(d)
+        if name and name.rsplit(".", 1)[-1] in ("jit", "pmap"):
+            return True
+        if isinstance(d, ast.Call):
+            callee = dotted(d.func)
+            if callee and callee.rsplit(".", 1)[-1] in ("jit", "pmap"):
+                return True
+            if callee and callee.rsplit(".", 1)[-1] == "partial" and d.args:
+                inner = dotted(d.args[0])
+                if inner and inner.rsplit(".", 1)[-1] in ("jit", "pmap"):
+                    return True
+    return False
+
+
+class TracePurityChecker:
+    name = "trace-purity"
+    doc = ("impure host effects (time/random/environ/telemetry/print/"
+           "logging, closed-over mutation) inside functions captured by "
+           "`jax.jit`/`lax.fori_loop`/`lax.scan`/`lax.while_loop`")
+
+    def run(self, ctx):
+        for unit in ctx.units:
+            if unit.tree is None:
+                continue
+            yield from self._check_unit(unit)
+
+    def _check_unit(self, unit):
+        defs = list(iter_defs(unit.tree))
+        spans = enclosing_context(unit.tree)
+        by_name = {}
+        for qual, _cls, fn in defs:
+            by_name.setdefault(fn.name, []).append((qual, fn))
+
+        traced = {}  # id(fn) -> (qual, fn, why)
+        for qual, _cls, fn in defs:
+            if _jit_decorated(fn):
+                traced[id(fn)] = (qual, fn, "jit-decorated")
+
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            positions = _traced_arg_positions(node)
+            callee = dotted(node.func) or "?"
+            for pos in positions:
+                if pos >= len(node.args):
+                    continue
+                arg = node.args[pos]
+                if isinstance(arg, ast.Lambda):
+                    yield from self._check_lambda(unit, arg, callee)
+                elif isinstance(arg, ast.Name):
+                    resolved = self._resolve_name(
+                        by_name.get(arg.id, ()), spans, node.lineno)
+                    if resolved is not None:
+                        qual, fn = resolved
+                        traced.setdefault(
+                            id(fn), (qual, fn, f"passed to {callee}"))
+
+        for qual, fn, why in traced.values():
+            yield from self._check_traced(unit, qual, fn, why)
+
+    @staticmethod
+    def _resolve_name(candidates, spans, call_line):
+        """The def a bare name at ``call_line`` refers to: among
+        same-named defs, only those whose *defining scope* encloses the
+        call are visible (module level always is); the innermost wins.
+        Matching on name alone would mark an unrelated same-named helper
+        elsewhere in the module as traced."""
+        if not candidates:
+            return None
+        context = ctx_of(spans, call_line)
+        best, best_depth = None, -1
+        for qual, fn in candidates:
+            parent = qual.rsplit(".", 1)[0] if "." in qual else ""
+            visible = (parent == "" or context == parent
+                       or context.startswith(parent + "."))
+            if visible and len(parent) > best_depth:
+                best, best_depth = (qual, fn), len(parent)
+        return best
+
+    def _check_lambda(self, unit, lam, callee):
+        params = {a.arg for a in lam.args.args + lam.args.kwonlyargs}
+        for node in ast.walk(lam):
+            yield from self._impure(unit, node, f"<lambda to {callee}>",
+                                    params)
+
+    def _check_traced(self, unit, qual, fn, why):
+        yield from self._scope_walk(unit, qual, fn, set())
+
+    def _scope_walk(self, unit, qual, fn, outer_locals):
+        """Check one function scope, then recurse into nested defs with
+        the enclosing locals accumulated — a nested body's own params and
+        assignments are locals THERE, not closed-over state."""
+        locals_ = outer_locals | local_names(fn)
+        nested = []
+        stack = list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.append(node)
+                continue
+            yield from self._impure(unit, node, qual, locals_)
+            stack.extend(ast.iter_child_nodes(node))
+        for inner in nested:
+            yield from self._scope_walk(unit, f"{qual}.{inner.name}",
+                                        inner, locals_)
+
+    def _impure(self, unit, node, qual, locals_):
+        if isinstance(node, ast.Call):
+            callee = dotted(node.func) or ""
+            head = callee.split(".", 1)[0]
+            if callee.startswith("time."):
+                yield self._f(unit, node, qual,
+                              f"`{callee}()` freezes one wall-clock value "
+                              "into the traced program")
+            elif head == "random" or callee.startswith(("np.random.",
+                                                        "numpy.random.")):
+                yield self._f(unit, node, qual,
+                              f"`{callee}()` bakes one host RNG draw into "
+                              "the trace — thread a jax.random key instead")
+            elif callee in ("os.getenv", "env.get", "_env.get") \
+                    or callee.startswith("os.environ"):
+                yield self._f(unit, node, qual,
+                              f"`{callee}(...)` pins config at trace time; "
+                              "read it outside and pass the value in")
+            elif head in _TELEMETRY_ROOTS or callee in (
+                    "counter", "gauge", "histogram", "span"):
+                yield self._f(unit, node, qual,
+                              f"telemetry call `{callee}` fires once per "
+                              "compile, not per step — instrument the "
+                              "dispatch site instead")
+            elif callee == "print":
+                yield self._f(unit, node, qual,
+                              "`print` inside a traced function runs at "
+                              "trace time only (use jax.debug.print)")
+            elif head in _LOG_ROOTS or callee.startswith("self.logger."):
+                yield self._f(unit, node, qual,
+                              f"logging call `{callee}` runs at trace "
+                              "time only")
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+            yield self._f(unit, node, qual,
+                          f"`{kind} {', '.join(node.names)}` mutation "
+                          "escapes the trace — return the value instead")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    base = root_name(t)
+                    if base is not None and base not in locals_ \
+                            and base != "_":
+                        yield self._f(
+                            unit, node, qual,
+                            f"mutates closed-over state `{base}` from "
+                            "inside a traced function (tracer leak)")
+
+    def _f(self, unit, node, qual, message):
+        return Finding(self.name, unit.path, node.lineno, message,
+                       context=qual)

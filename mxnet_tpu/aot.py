@@ -259,8 +259,8 @@ class AOTProgram:
                 self.executable = loaded
                 return loaded
             try:
-                _tm.counter(self._counter).inc()
-                with _tm.span(self._span):
+                _tm.counter(self._counter).inc()  # graftlint: allow=telemetry-catalog(forwards a constructor-chosen literal: executor.jit_compile or aot.trace_compile, both catalogued)
+                with _tm.span(self._span):  # graftlint: allow=telemetry-catalog(forwards a constructor-chosen literal: executor.jit_build or aot.compile, both catalogued)
                     compiled = self.jit_fn.lower(*args).compile()
             except Exception:
                 # tracing raised (e.g. a graph-contract error) or AOT
@@ -461,7 +461,7 @@ class TrainWindowScheduler:
 
     def _rebase(self):
         for name in self._PHASES:
-            h = _tm.histogram(name)
+            h = _tm.histogram(name)  # graftlint: allow=telemetry-catalog(reads the existing fit.* phase histograms enumerated in _PHASES; mints no names)
             self._base[name] = (h.count, h.sum)
         self._batches = 0
 
@@ -489,7 +489,7 @@ class TrainWindowScheduler:
         deltas = {}
         reset_seen = False
         for name, (c0, s0) in self._base.items():
-            h = _tm.histogram(name)
+            h = _tm.histogram(name)  # graftlint: allow=telemetry-catalog(reads the fit.* phase histograms rebased from _PHASES; mints no names)
             dc_, ds_ = h.count - c0, h.sum - s0
             # ANY negative delta means telemetry was reset mid-probe
             # (bench's compile-epoch reset) — a residual computed from a

@@ -100,7 +100,9 @@ class Engine:
         if prev is None:
             prev = 15 if _env.get("MXNET_EXEC_BULK_EXEC_TRAIN") else 0
         self._bulk_size = int(size)
-        os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"] = "0" if size == 0 else "1"
+        # the env var IS the API contract here: Module re-reads it (via
+        # env.get) at each update, and child processes must inherit it
+        os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"] = "0" if size == 0 else "1"  # graftlint: allow=env-registry(set_bulk_size's documented mechanism is flipping the declared var for later env.get reads)
         return prev
 
 

@@ -102,6 +102,11 @@ _declare("MXNET_PS_EXIT_TIMEOUT", float, 3600.0,
          "worker's done marker before shutting down anyway (stragglers "
          "are the point of async mode, so the default is generous; "
          "launcher-supervised jobs can set it low for fast restarts).")
+_declare("MXNET_PS_KEY", str, "",
+         "Hex-encoded pre-shared key authenticating every dist_async "
+         "wire frame (tools/launch.py generates and exports one per job, "
+         "delivered via stdin rather than argv). Empty = unauthenticated "
+         "(single-host dev runs).")
 _declare("MXNET_PS_MAX_FRAME", int, 1 << 31,
          "Upper bound in bytes on a single dist_async wire frame payload "
          "— a parse-time allocation guard on the typed tensor protocol.")
@@ -206,6 +211,11 @@ _declare("MXNET_FI_CORRUPT_CKPT", str, "",
          "Fault injection: 'truncate' or 'garbage' — damage each "
          "checkpoint's params file right after commit, forcing digest "
          "verification to fall back to the previous valid checkpoint.")
+_declare("MXNET_NUM_RESTARTS", int, 0,
+         "Launcher attempt ordinal, exported by tools/launch.py "
+         "--max-restarts relaunches (0 = first life). Read by dead-node "
+         "accounting and to scope MXNET_FI_* fault injection to one "
+         "attempt.")
 _declare("MXNET_FI_ATTEMPT", int, 0,
          "Launcher attempt (MXNET_NUM_RESTARTS value) the MXNET_FI_* "
          "injections apply to; -1 = every attempt.")
@@ -334,6 +344,16 @@ def get(name):
         return var.parse(raw)
     except (TypeError, ValueError):
         return var.default
+
+
+def raw(name):
+    """The uninterpreted environ string of a declared variable, or None
+    when unset — for the few callers that must distinguish set-empty from
+    absent (rank detection, auth keys). The name must still be declared:
+    this is the registry-audited spelling of ``os.environ.get``."""
+    if name not in _CATALOGUE:
+        raise KeyError(f"{name} is not declared in mxnet_tpu.env")
+    return os.environ.get(name)
 
 
 def document():

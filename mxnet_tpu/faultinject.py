@@ -63,6 +63,7 @@ from __future__ import annotations
 import os
 import threading
 
+from . import env as _env
 from . import telemetry as _tm
 from .base import MXNetError
 from .io import DataIter
@@ -73,7 +74,7 @@ _serve_ordinal = 0   # process-global count of serving batch attempts
 
 
 def _csv_ints(name):
-    raw = os.environ.get(name, "")
+    raw = _env.get(name)
     out = set()
     for part in raw.split(","):
         part = part.strip()
@@ -86,22 +87,22 @@ def _csv_ints(name):
 
 
 def _attempt_matches():
-    want = int(os.environ.get("MXNET_FI_ATTEMPT", "0") or 0)
+    want = _env.get("MXNET_FI_ATTEMPT")
     if want < 0:
         return True  # -1: every attempt
-    return int(os.environ.get("MXNET_NUM_RESTARTS", "0") or 0) == want
+    return _env.get("MXNET_NUM_RESTARTS") == want
 
 
 def _rank_matches():
-    want = int(os.environ.get("MXNET_FI_RANK", "-1") or -1)
+    want = _env.get("MXNET_FI_RANK")
     if want < 0:
         return True  # any rank
-    return int(os.environ.get("MXNET_PROC_ID", "0") or 0) == want
+    return _env.get("MXNET_PROC_ID") == want
 
 
 def active():
     """True when any fault is configured for THIS launcher attempt+rank."""
-    if not any(os.environ.get(k) for k in (
+    if not any(_env.raw(k) for k in (
             "MXNET_FI_CRASH_AT_BATCH", "MXNET_FI_NAN_BATCHES",
             "MXNET_FI_ITER_RAISE_BATCHES", "MXNET_FI_CORRUPT_CKPT")):
         return False
@@ -126,11 +127,11 @@ def on_train_batch(data_batch):
     with _lock:
         _batch_ordinal += 1
         ordinal = _batch_ordinal
-    crash_at = int(os.environ.get("MXNET_FI_CRASH_AT_BATCH", "-1") or -1)
+    crash_at = _env.get("MXNET_FI_CRASH_AT_BATCH")
     if crash_at >= 0 and ordinal == crash_at:
         # a real machine death: no atexit, no flushes beyond this print
         print(f"faultinject: CRASH at train batch {ordinal}", flush=True)
-        os._exit(int(os.environ.get("MXNET_FI_EXIT_CODE", "17")))
+        os._exit(_env.get("MXNET_FI_EXIT_CODE"))
     if ordinal in _csv_ints("MXNET_FI_NAN_BATCHES"):
         _tm.counter("faultinject.nan_batch").inc()
         _poison_batch(data_batch)
@@ -163,7 +164,7 @@ def serving_active():
     """True when any serving-path fault is configured for THIS launcher
     attempt+rank (separate from :func:`active` — serving faults must not
     flip fit's window-fusion opt-out)."""
-    if not any(os.environ.get(k) for k in (
+    if not any(_env.raw(k) for k in (
             "MXNET_FI_SERVE_RAISE_REPLICA", "MXNET_FI_SERVE_LATENCY_MS",
             "MXNET_FI_SERVE_FAIL_EVERY", "MXNET_FI_SERVE_RELOAD_CORRUPT")):
         return False
@@ -179,10 +180,9 @@ def on_serving_forward(replica_id):
     global _serve_ordinal
     if not serving_active():
         return
-    lat = float(os.environ.get("MXNET_FI_SERVE_LATENCY_MS", "0") or 0)
+    lat = _env.get("MXNET_FI_SERVE_LATENCY_MS")
     if lat > 0:
-        who = int(os.environ.get("MXNET_FI_SERVE_LATENCY_REPLICA", "-1")
-                  or -1)
+        who = _env.get("MXNET_FI_SERVE_LATENCY_REPLICA")
         if who < 0 or who == replica_id:
             _tm.counter("faultinject.serve_latency").inc()
             import time
@@ -193,7 +193,7 @@ def on_serving_forward(replica_id):
         raise MXNetError(
             f"faultinject: injected forward failure on replica "
             f"{replica_id}")
-    every = int(os.environ.get("MXNET_FI_SERVE_FAIL_EVERY", "0") or 0)
+    every = _env.get("MXNET_FI_SERVE_FAIL_EVERY")
     if every > 0:
         with _lock:
             _serve_ordinal += 1
@@ -222,7 +222,7 @@ def post_checkpoint_commit(params_path):
     """Called by CheckpointManager right after a checkpoint commits:
     optionally damages the just-written params file (simulating later disk
     corruption / a torn replica) so the NEXT load must fall back."""
-    mode = os.environ.get("MXNET_FI_CORRUPT_CKPT", "")
+    mode = _env.get("MXNET_FI_CORRUPT_CKPT")
     if not mode or not _attempt_matches() or not _rank_matches():
         return
     corrupt_file(params_path, mode)

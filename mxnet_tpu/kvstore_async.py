@@ -79,7 +79,9 @@ class _WireError(MXNetError):
 
 
 def _wire_key():
-    raw = os.environ.get("MXNET_PS_KEY", "")
+    from . import env
+
+    raw = env.get("MXNET_PS_KEY")
     return bytes.fromhex(raw) if raw else None
 
 
@@ -393,11 +395,11 @@ class AsyncDistKVStore(KVStore):
 
     def __init__(self, kv_type="dist_async"):
         super().__init__(kv_type)
-        self._rank = int(os.environ.get("MXNET_PROC_ID", "0"))
-        self._size = int(os.environ.get("MXNET_NUM_PROCS", "1"))
         from . import env
 
-        coord = os.environ.get("MXNET_COORDINATOR", "127.0.0.1:9127")
+        self._rank = env.get("MXNET_PROC_ID")
+        self._size = env.get("MXNET_NUM_PROCS")
+        coord = env.get("MXNET_COORDINATOR") or "127.0.0.1:9127"
         host, _, port = coord.rpartition(":")
         ps_port = env.get("MXNET_PS_PORT") or int(port) + 512
         self._server = None

@@ -30,28 +30,55 @@ class BucketingModule(BaseModule):
                  state_names=None):
         super().__init__(logger=logger)
         assert default_bucket_key is not None
-        self._default_bucket_key = default_bucket_key
         self._sym_gen = sym_gen
-
-        symbol, data_names, label_names = sym_gen(default_bucket_key)
-        data_names = list(data_names) if data_names is not None else []
-        label_names = list(label_names) if label_names is not None else []
-        state_names = list(state_names) if state_names is not None else []
-        fixed_param_names = list(fixed_param_names) if fixed_param_names is not None else []
-        _check_input_names(symbol, data_names, "data", True)
-        _check_input_names(symbol, label_names, "label", False)
-        _check_input_names(symbol, state_names, "state", True)
-        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
-
-        self._fixed_param_names = fixed_param_names
-        self._state_names = state_names
+        self._default_bucket_key = default_bucket_key
         self._context = context
         self._work_load_list = work_load_list
-
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._state_names = list(state_names or [])
+        self._validate_sym_gen()
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
         self._params_dirty = False
+
+    def _validate_sym_gen(self):
+        """Check the sym_gen contract on the default bucket up front:
+        every declared name family must resolve against the generated
+        symbol's arguments — a bad generator should fail at construction,
+        not at the first bucket switch mid-training."""
+        symbol, data_names, label_names = \
+            self._sym_gen(self._default_bucket_key)
+        for names, kind, required in (
+                (list(data_names or []), "data", True),
+                (list(label_names or []), "label", False),
+                (self._state_names, "state", True),
+                (self._fixed_param_names, "fixed_param", True)):
+            _check_input_names(symbol, names, kind, required)
+
+    def _module_for(self, bucket_key):
+        """A fresh (unbound) Module for one bucket key — the single place
+        the per-bucket construction recipe lives."""
+        symbol, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(
+            symbol, data_names, label_names, logger=self.logger,
+            context=self._context, work_load_list=self._work_load_list,
+            fixed_param_names=self._fixed_param_names,
+            state_names=self._state_names,
+        )
+
+    def _require(self, *, bound=False, params=False, optimizer=False,
+                 grads=False):
+        """State preconditions, Module-style: one place instead of a
+        per-method assert chain."""
+        if bound:
+            assert self.binded, "call bind() first"
+        if params:
+            assert self.params_initialized, "call init_params() first"
+        if optimizer:
+            assert self.optimizer_initialized, "call init_optimizer() first"
+        if grads:
+            assert self.inputs_need_grad, "bind with inputs_need_grad=True"
 
     def _reset_bind(self):
         self.binded = False
@@ -75,26 +102,26 @@ class BucketingModule(BaseModule):
 
     @property
     def data_shapes(self):
-        assert self.binded
+        self._require(bound=True)
         return self._curr_module.data_shapes
 
     @property
     def label_shapes(self):
-        assert self.binded
+        self._require(bound=True)
         return self._curr_module.label_shapes
 
     @property
     def output_shapes(self):
-        assert self.binded
+        self._require(bound=True)
         return self._curr_module.output_shapes
 
     @property
     def symbol(self):
-        assert self.binded
+        self._require(bound=True)
         return self._curr_module.symbol
 
     def get_params(self):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, params=True)
         self._curr_module._params_dirty = self._params_dirty
         params = self._curr_module.get_params()
         self._params_dirty = False
@@ -125,7 +152,7 @@ class BucketingModule(BaseModule):
                     aux_params=None, allow_missing=False, force_init=False):
         if self.params_initialized and not force_init:
             return
-        assert self.binded, "call bind before initializing the parameters"
+        self._require(bound=True)
         self._curr_module.init_params(
             initializer=initializer, arg_params=arg_params,
             aux_params=aux_params, allow_missing=allow_missing,
@@ -135,11 +162,12 @@ class BucketingModule(BaseModule):
         self.params_initialized = True
 
     def get_states(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
-        return self._curr_module.get_states(merge_multi_context=merge_multi_context)
+        self._require(bound=True, params=True)
+        return self._curr_module.get_states(
+            merge_multi_context=merge_multi_context)
 
     def set_states(self, states=None, value=None):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, params=True)
         self._curr_module.set_states(states, value)
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -156,13 +184,7 @@ class BucketingModule(BaseModule):
         self.inputs_need_grad = inputs_need_grad
         self.binded = True
 
-        symbol, data_names, label_names = self._sym_gen(self._default_bucket_key)
-        module = Module(
-            symbol, data_names, label_names, logger=self.logger,
-            context=self._context, work_load_list=self._work_load_list,
-            fixed_param_names=self._fixed_param_names,
-            state_names=self._state_names,
-        )
+        module = self._module_for(self._default_bucket_key)
         module.bind(
             data_shapes, label_shapes, for_training, inputs_need_grad,
             force_rebind=False, shared_module=None, grad_req=grad_req,
@@ -181,25 +203,20 @@ class BucketingModule(BaseModule):
         (and later compile) a NEW bucket — steady-state bucket-miss
         recompiles are a perf bug worth surfacing.
         """
-        assert self.binded, "call bind before switching bucket"
+        self._require(bound=True)
         if bucket_key != self._curr_bucket_key:
             _tm.counter("bucketing.switch").inc()
         if bucket_key not in self._buckets:
             _tm.counter("bucketing.compile_on_switch").inc()
-            symbol, data_names, label_names = self._sym_gen(bucket_key)
-            module = Module(
-                symbol, data_names, label_names, logger=self.logger,
-                context=self._context, work_load_list=self._work_load_list,
-                fixed_param_names=self._fixed_param_names,
-                state_names=self._state_names,
-            )
+            default = self._buckets[self._default_bucket_key]
+            module = self._module_for(bucket_key)
             module.bind(
                 data_shapes, label_shapes, self._curr_module.for_training,
                 self._curr_module.inputs_need_grad, force_rebind=False,
-                shared_module=self._buckets[self._default_bucket_key],
+                shared_module=default,
             )
             if self.optimizer_initialized:
-                module.borrow_optimizer(self._buckets[self._default_bucket_key])
+                module.borrow_optimizer(default)
             self._buckets[bucket_key] = module
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
@@ -207,7 +224,7 @@ class BucketingModule(BaseModule):
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, params=True)
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
@@ -232,7 +249,7 @@ class BucketingModule(BaseModule):
         populates the persistent executable cache. The active bucket is
         restored. Returns ``{bucket_key: [kinds compiled]}``.
         """
-        assert self.binded, "call bind before compiling buckets"
+        self._require(bound=True)
         original_key = self._curr_bucket_key
         for spec in buckets or ():
             key, data_shapes, label_shapes = spec
@@ -257,16 +274,16 @@ class BucketingModule(BaseModule):
         return {key: kinds for (key, _mod), kinds in zip(items, compiled)}
 
     def prepare(self, data_batch):
-        assert self.binded and self.params_initialized
-        bucket_key = data_batch.bucket_key
-        original_bucket_key = self._curr_bucket_key
-        data_shapes = data_batch.provide_data
-        label_shapes = data_batch.provide_label
-        self.switch_bucket(bucket_key, data_shapes, label_shapes)
-        self.switch_bucket(original_bucket_key, None, None)
+        """Pre-bind the batch's bucket without making it current (the
+        prefetch path warms the program for batch N+1 this way)."""
+        self._require(bound=True, params=True)
+        active = self._curr_bucket_key
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self.switch_bucket(active, None, None)
 
     def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, params=True)
         self.switch_bucket(
             data_batch.bucket_key, data_batch.provide_data,
             data_batch.provide_label,
@@ -274,28 +291,30 @@ class BucketingModule(BaseModule):
         self._curr_module.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, params=True)
         self._curr_module.backward(out_grads=out_grads)
 
     def update(self):
-        assert self.binded and self.params_initialized and self.optimizer_initialized
+        self._require(bound=True, params=True, optimizer=True)
         self._params_dirty = True
         self._curr_module.update()
 
     def get_outputs(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
-        return self._curr_module.get_outputs(merge_multi_context=merge_multi_context)
+        self._require(bound=True, params=True)
+        return self._curr_module.get_outputs(
+            merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and self.inputs_need_grad
-        return self._curr_module.get_input_grads(merge_multi_context=merge_multi_context)
+        self._require(bound=True, params=True, grads=True)
+        return self._curr_module.get_input_grads(
+            merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, params=True)
         self._curr_module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
-        assert self.binded
+        self._require(bound=True)
         for mod in self._buckets.values():
             mod.install_monitor(mon)
 

@@ -47,24 +47,27 @@ class Optimizer:
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  sym=None, begin_num_update=0):
+        if param_idx2name is not None and not isinstance(param_idx2name,
+                                                         dict):
+            raise TypeError(
+                "param_idx2name should be a dict of param indexes to names."
+            )
+        # gradient preprocessing knobs (applied rescale -> wd -> clip)
         self.rescale_grad = rescale_grad
+        self.clip_gradient = clip_gradient
+        self.wd = wd
+        # learning rate: a scheduler, when given, owns the base lr
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
         if lr_scheduler is not None:
-            self.lr_scheduler.base_lr = learning_rate
-        self.wd = wd
-        self.lr_mult = {}
-        self.wd_mult = {}
-        self.begin_num_update = begin_num_update
-        self.num_update = begin_num_update
+            lr_scheduler.base_lr = learning_rate
+        # update bookkeeping (num_update drives schedules; per-index
+        # counts drive bias correction, e.g. Adam's t)
+        self.num_update = self.begin_num_update = begin_num_update
         self._index_update_count = {}
-        self.clip_gradient = clip_gradient
-        if param_idx2name is None:
-            param_idx2name = {}
-        assert isinstance(param_idx2name, dict), (
-            "param_idx2name should be a dict of param indexes to names."
-        )
-        self.idx2name = param_idx2name.copy()
+        # name resolution for the per-param lr/wd multiplier tables,
+        # seeded from symbol attributes + the bias/gamma/beta heuristic
+        self.idx2name = dict(param_idx2name or {})
         self.sym = sym
         self.set_lr_mult({})
         self.set_wd_mult({})
@@ -110,25 +113,30 @@ class Optimizer:
     def set_lr_scale(self, args_lrscale):
         raise DeprecationWarning("Use set_lr_mult instead.")
 
+    def _sym_mults(self, attr_key):
+        """Per-param multipliers declared as symbol attributes (the
+        ``__lr_mult__``/``__wd_mult__`` middle tier of the priority
+        order: explicit dicts > symbol attrs > heuristics)."""
+        if self.sym is None:
+            return {}
+        attrs = self.sym.attr_dict()
+        return {
+            name: float(attrs[name][attr_key])
+            for name in self.sym.list_arguments()
+            if attr_key in attrs.get(name, ())
+        }
+
     def set_lr_mult(self, args_lr_mult):
-        self.lr_mult = {}
-        if self.sym is not None:
-            attr = self.sym.attr_dict()
-            for name in self.sym.list_arguments():
-                if name in attr and "__lr_mult__" in attr[name]:
-                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult = self._sym_mults("__lr_mult__")
         self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
-        self.wd_mult = {}
-        for n in self.idx2name.values():
-            if not (n.endswith("_weight") or n.endswith("_gamma")):
-                self.wd_mult[n] = 0.0
-        if self.sym is not None:
-            attr = self.sym.attr_dict()
-            for name in self.sym.list_arguments():
-                if name in attr and "__wd_mult__" in attr[name]:
-                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        # heuristic tier: biases and BN scale/shift take no weight decay
+        self.wd_mult = {
+            n: 0.0 for n in self.idx2name.values()
+            if not n.endswith(("_weight", "_gamma"))
+        }
+        self.wd_mult.update(self._sym_mults("__wd_mult__"))
         self.wd_mult.update(args_wd_mult)
 
     def _update_count(self, index):

@@ -496,7 +496,7 @@ class ModelServer:
         for name, shape in self._sample_shapes.items():
             if name not in inputs:
                 raise MXNetError(f"missing input {name!r}")
-            arr = np.asarray(inputs[name])
+            arr = np.asarray(inputs[name])  # graftlint: allow=host-sync(coerces the client payload, which is host data by definition; no device handle reaches admission)
             if tuple(arr.shape) != shape:
                 raise MXNetError(
                     f"input {name!r}: per-sample shape {shape} expected, "

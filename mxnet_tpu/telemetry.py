@@ -183,8 +183,8 @@ def _env_spans():
 
         return bool(_env.get("MXNET_TELEMETRY"))
     except Exception:
-        return str(os.environ.get("MXNET_TELEMETRY", "")).lower() not in (
-            "", "0", "false")
+        raw = os.environ.get("MXNET_TELEMETRY", "")  # graftlint: allow=env-registry(standalone-import fallback: the trace_merge CLI uses telemetry without the package, so the registry may be unimportable here)
+        return str(raw).lower() not in ("", "0", "false")
 
 
 _spans_on = _env_spans()

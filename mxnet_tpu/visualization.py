@@ -1,192 +1,195 @@
-"""Network visualization.
+"""Network visualization: ``print_summary`` (layer table) and
+``plot_network`` (graphviz digraph).
 
-Reference: ``python/mxnet/visualization.py`` — ``print_summary`` (layer table
-with shapes/params) and ``plot_network`` (graphviz digraph).
+Reference surface: ``python/mxnet/visualization.py``. The implementation
+here is organised around one shared traversal of the symbol's graph JSON:
+:func:`_graph_nodes` decodes it, :func:`_internal_shapes` runs shape
+inference over ``get_internals()`` once, and both entry points consume
+those instead of re-walking the JSON ad hoc.
 """
 
 from __future__ import annotations
 
 import json
 
-from .base import MXNetError
 from .symbol import Symbol
 
 
-def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
-    """Print a layer-by-layer summary (reference print_summary)."""
+def _graph_nodes(symbol):
+    """(nodes, head_ids) from the symbol's serialized graph."""
+    conf = json.loads(symbol.tojson())
+    return conf["nodes"], {h[0] for h in conf["heads"]}
+
+
+def _internal_shapes(symbol, shape_kwargs):
+    """name -> inferred output shape for every internal output.
+
+    Raises ``ValueError`` when the given input shapes underdetermine the
+    graph (mirrors the reference's incomplete-shape error).
+    """
+    internals = symbol.get_internals()
+    _, out_shapes, _ = internals.infer_shape(**shape_kwargs)
+    if out_shapes is None:
+        raise ValueError("Input shape is incomplete")
+    return dict(zip(internals.list_outputs(), out_shapes))
+
+
+def _shape_of(node, shape_dict):
+    """This node's inferred output shape sans batch dim ([] if unknown)."""
+    key = node["name"] if node["op"] == "null" else node["name"] + "_output"
+    full = shape_dict.get(key)
+    return list(full[1:]) if full else []
+
+
+def _feeders(node, nodes, head_ids):
+    """Names of the non-weight nodes feeding ``node``."""
+    if node["op"] == "null":
+        return []
+    out = []
+    for src_id, *_ in node["inputs"]:
+        src = nodes[src_id]
+        if src["op"] != "null" or src_id in head_ids:
+            out.append(src["name"])
+    return out
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a layer-by-layer summary table (reference ``print_summary``).
+
+    ``positions`` are column right-edges as fractions of ``line_length``.
+    """
     if not isinstance(symbol, Symbol):
         raise TypeError("symbol must be Symbol")
-    show_shape = False
-    shape_dict = {}
-    if shape is not None:
-        show_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**shape)
-        if out_shapes is None:
-            raise ValueError("Input shape is incomplete")
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
-    conf = json.loads(symbol.tojson())
-    nodes = conf["nodes"]
-    heads = {x[0] for x in conf["heads"]}
-    positions = [int(line_length * p) for p in positions]
-    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+    shape_dict = _internal_shapes(symbol, shape) if shape is not None else {}
+    nodes, head_ids = _graph_nodes(symbol)
+    edges = [int(line_length * p) for p in positions]
 
-    def print_row(fields, positions):
-        line = ""
-        for i, field in enumerate(fields):
-            line += str(field)
-            line = line[: positions[i]]
-            line += " " * (positions[i] - len(line))
-        print(line)
+    def emit(columns):
+        row = ""
+        for text, edge in zip(columns, edges):
+            row = (row + str(text))[:edge].ljust(edge)
+        print(row)
 
-    print("_" * line_length)
-    print_row(to_display, positions)
-    print("=" * line_length)
-
-    total_params = 0
-
-    def print_layer_summary(node, out_shape):
-        nonlocal total_params
-        op = node["op"]
-        pre_node = []
-        if op != "null":
-            inputs = node["inputs"]
-            for item in inputs:
-                input_node = nodes[item[0]]
-                input_name = input_node["name"]
-                if input_node["op"] != "null" or item[0] in heads:
-                    pre_node.append(input_name)
-        cur_param = 0
+    def param_count(node):
+        # only Convolution carries a cheaply-derivable count in the graph
+        # attrs; everything else reports 0 (as the reference table does
+        # for ops it cannot size without binding)
+        if node["op"] != "Convolution":
+            return 0
         attrs = node.get("attrs", {})
-        if op == "Convolution":
-            from .base import parse_shape, parse_bool
+        in_ch = int(attrs.get("__in_channels__", 0) or 1)
+        return int(attrs["num_filter"]) * in_ch
 
-            num_filter = int(attrs["num_filter"])
-            kernel = parse_shape(attrs["kernel"])
-            num_group = int(attrs.get("num_group", "1"))
-            cur_param = num_filter * int(attrs.get("__in_channels__", 0) or 1)
-        name = node["name"]
-        first_connection = pre_node[0] if pre_node else ""
-        fields = [
-            f"{name}({op})",
-            f"{out_shape}",
-            f"{cur_param}",
-            first_connection,
-        ]
-        print_row(fields, positions)
-        for i in range(1, len(pre_node)):
-            fields = ["", "", "", pre_node[i]]
-            print_row(fields, positions)
+    rule_heavy = "=" * line_length
+    rule_light = "_" * line_length
+    print(rule_light)
+    emit(["Layer (type)", "Output Shape", "Param #", "Previous Layer"])
+    print(rule_heavy)
 
+    total = 0
     for i, node in enumerate(nodes):
-        out_shape = []
-        op = node["op"]
-        if op == "null" and i > 0:
+        if node["op"] == "null" and i > 0:
             continue
-        if op != "null" or i in heads:
-            if show_shape:
-                key = node["name"] + "_output" if op != "null" else node["name"]
-                if key in shape_dict:
-                    out_shape = shape_dict[key][1:]
-        print_layer_summary(node, out_shape)
-        if i == len(nodes) - 1:
-            print("=" * line_length)
-        else:
-            print("_" * line_length)
-    print(f"Total params: {total_params}")
-    print("_" * line_length)
+        out_shape = _shape_of(node, shape_dict) if shape is not None else []
+        feeders = _feeders(node, nodes, head_ids)
+        n_params = param_count(node)
+        total += n_params
+        emit([f"{node['name']}({node['op']})", out_shape, n_params,
+              feeders[0] if feeders else ""])
+        for extra in feeders[1:]:
+            emit(["", "", "", extra])
+        print(rule_heavy if i == len(nodes) - 1 else rule_light)
+    print(f"Total params: {total}")
+    print(rule_light)
+
+
+_WEIGHT_SUFFIXES = ("_weight", "_bias", "_beta", "_gamma",
+                    "_moving_var", "_moving_mean")
+
+#: categorical fill palette (colorbrewer Set3, as the reference uses)
+_PALETTE = ("#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3",
+            "#fdb462", "#b3de69", "#fccde5")
+
+
+def _node_style(node):
+    """(label, fillcolor) for one graph node, by op family."""
+    op = node["op"]
+    attrs = node.get("attrs", {})
+
+    def a(key):
+        return attrs.get(key, "")
+
+    if op == "null":
+        return node["name"], _PALETTE[0]
+    if op == "Convolution":
+        return (f"Convolution\n{a('kernel')}/{a('stride')}, "
+                f"{a('num_filter')}", _PALETTE[1])
+    if op == "FullyConnected":
+        return f"FullyConnected\n{a('num_hidden')}", _PALETTE[1]
+    if op in ("Activation", "LeakyReLU"):
+        return f"{op}\n{a('act_type')}", _PALETTE[2]
+    if op == "BatchNorm":
+        return node["name"], _PALETTE[3]
+    if op == "Pooling":
+        return (f"Pooling\n{a('pool_type')}, {a('kernel')}/{a('stride')}",
+                _PALETTE[4])
+    if op in ("Concat", "Flatten", "Reshape"):
+        return node["name"], _PALETTE[5]
+    if op in ("Softmax", "SoftmaxOutput"):
+        return node["name"], _PALETTE[6]
+    return node["name"], _PALETTE[7]
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,
                  node_attrs=None, hide_weights=True):
-    """Build a graphviz digraph of the network (reference plot_network)."""
+    """Build a graphviz digraph of the network (reference ``plot_network``).
+
+    Weight/statistic inputs are elided when ``hide_weights``; with
+    ``shape`` given, edges are labelled with the tensor shape flowing
+    along them.
+    """
     try:
         from graphviz import Digraph
     except ImportError as e:
         raise ImportError("Draw network requires graphviz library") from e
     if not isinstance(symbol, Symbol):
         raise TypeError("symbol must be a Symbol")
-    draw_shape = False
-    shape_dict = {}
-    if shape is not None:
-        draw_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**shape)
-        if out_shapes is None:
-            raise ValueError("Input shape is incomplete")
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
-    conf = json.loads(symbol.tojson())
-    nodes = conf["nodes"]
-    node_attr = {
-        "shape": "box", "fixedsize": "true", "width": "1.3", "height": "0.8034",
-        "style": "filled",
-    }
-    node_attr.update(node_attrs or {})
+
+    shape_dict = _internal_shapes(symbol, shape) if shape is not None else {}
+    nodes, _head_ids = _graph_nodes(symbol)
+
+    base_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    base_attr.update(node_attrs or {})
     dot = Digraph(name=title, format=save_format)
-    cm = ("#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3", "#fdb462",
-          "#b3de69", "#fccde5")
 
-    def looks_like_weight(name):
-        return name.endswith(("_weight", "_bias", "_beta", "_gamma",
-                              "_moving_var", "_moving_mean"))
-
-    hidden_nodes = set()
+    hidden = set()
     for node in nodes:
-        op = node["op"]
         name = node["name"]
-        attr = node_attr.copy()
-        label = name
-        if op == "null":
-            if looks_like_weight(name):
-                if hide_weights:
-                    hidden_nodes.add(name)
-                continue
+        if node["op"] == "null" and name.endswith(_WEIGHT_SUFFIXES):
+            # weight/statistic inputs are never drawn as styled nodes
+            # (reference behaviour); hide_weights additionally suppresses
+            # the edges to them, otherwise they appear as bare endpoints
+            if hide_weights:
+                hidden.add(name)
+            continue
+        label, fill = _node_style(node)
+        attr = dict(base_attr, fillcolor=fill)
+        if node["op"] == "null":
             attr["shape"] = "oval"
-            label = name
-            attr["fillcolor"] = cm[0]
-        elif op == "Convolution":
-            a = node.get("attrs", {})
-            label = f"Convolution\n{a.get('kernel','')}/{a.get('stride','')}, {a.get('num_filter','')}"
-            attr["fillcolor"] = cm[1]
-        elif op == "FullyConnected":
-            a = node.get("attrs", {})
-            label = f"FullyConnected\n{a.get('num_hidden','')}"
-            attr["fillcolor"] = cm[1]
-        elif op == "BatchNorm":
-            attr["fillcolor"] = cm[3]
-        elif op == "Activation" or op == "LeakyReLU":
-            a = node.get("attrs", {})
-            label = f"{op}\n{a.get('act_type','')}"
-            attr["fillcolor"] = cm[2]
-        elif op == "Pooling":
-            a = node.get("attrs", {})
-            label = f"Pooling\n{a.get('pool_type','')}, {a.get('kernel','')}/{a.get('stride','')}"
-            attr["fillcolor"] = cm[4]
-        elif op in ("Concat", "Flatten", "Reshape"):
-            attr["fillcolor"] = cm[5]
-        elif op == "Softmax" or op == "SoftmaxOutput":
-            attr["fillcolor"] = cm[6]
-        else:
-            attr["fillcolor"] = cm[7]
         dot.node(name=name, label=label, **attr)
 
     for node in nodes:
-        op = node["op"]
-        name = node["name"]
-        if op == "null":
+        if node["op"] == "null":
             continue
-        inputs = node["inputs"]
-        for item in inputs:
-            input_node = nodes[item[0]]
-            input_name = input_node["name"]
-            if input_name in hidden_nodes:
+        for src_id, *_ in node["inputs"]:
+            src = nodes[src_id]
+            if src["name"] in hidden:
                 continue
             attr = {"dir": "back", "arrowtail": "open"}
-            if draw_shape:
-                key = (input_name + "_output" if input_node["op"] != "null"
-                       else input_name)
-                if key in shape_dict:
-                    shape = shape_dict[key][1:]
-                    attr["label"] = "x".join([str(x) for x in shape])
-            dot.edge(tail_name=name, head_name=input_name, **attr)
+            flowing = _shape_of(src, shape_dict)
+            if flowing:
+                attr["label"] = "x".join(str(d) for d in flowing)
+            dot.edge(tail_name=node["name"], head_name=src["name"], **attr)
     return dot

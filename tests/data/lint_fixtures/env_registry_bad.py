@@ -1,0 +1,18 @@
+"""Seeded env-registry violations: raw MXNET_* environ access."""
+import os
+
+
+def windows_enabled():
+    return os.environ.get("MXNET_TRAIN_WINDOW", "") != ""   # BAD: raw read
+
+
+def force_windows(k):
+    os.environ["MXNET_TRAIN_WINDOW"] = str(k)               # BAD: raw write
+
+
+def has_rank():
+    return "MXNET_PROC_ID" in os.environ                    # BAD: raw probe
+
+
+def sniff(name):
+    return os.environ.get(name)        # BAD: dynamic, unauditable key

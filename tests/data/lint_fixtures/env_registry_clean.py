@@ -1,0 +1,17 @@
+"""Clean counterpart: every MXNET_* read routes through the registry;
+foreign variables are outside its jurisdiction."""
+import os
+
+from mxnet_tpu import env
+
+
+def windows_enabled():
+    return env.get("MXNET_TRAIN_WINDOW") != ""
+
+
+def has_rank():
+    return env.raw("MXNET_PROC_ID") is not None
+
+
+def jax_platform():
+    return os.environ.get("JAX_PLATFORMS", "")   # fine: not an MXNET_* var

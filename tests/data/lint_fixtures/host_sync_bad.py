@@ -1,0 +1,9 @@
+"""Seeded host-sync violation: a declared hot path that syncs per batch."""
+
+
+# graftlint: hotpath
+def serve_batch(batcher, batch):
+    out = batcher.run(batch)
+    host = out.asnumpy()          # BAD: d2h sync on the request path
+    out.wait_to_read()            # BAD: execution fence per batch
+    return host

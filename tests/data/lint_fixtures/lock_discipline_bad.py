@@ -1,0 +1,29 @@
+"""Seeded lock-discipline violations: ABBA cycle, mixed guarded/unguarded
+mutation, blocking work + future resolution under the run lock."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._health = threading.Lock()
+        self._route = threading.Lock()
+        self.run_lock = threading.Lock()
+        self.version = 0
+
+    def mark_down(self, rid):
+        with self._health:          # A then B
+            with self._route:
+                self.version += 1
+
+    def pick(self):
+        with self._route:           # B then A: ABBA cycle
+            with self._health:
+                return self.version
+
+    def reload(self, v):
+        self.version = v            # BAD: same field written lock-free
+
+    def dispatch(self, fut, model, batch):
+        with self.run_lock:
+            out = model.forward(batch)   # BAD: device call under run lock
+            fut.set_result(out)          # BAD: client callback under lock

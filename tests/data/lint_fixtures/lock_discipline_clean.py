@@ -1,0 +1,35 @@
+"""Clean counterpart: one global acquisition order, every shared-field
+write guarded, the run lock held only around the swap itself."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._health = threading.Lock()
+        self._route = threading.Lock()
+        self.run_lock = threading.Lock()
+        self.version = 0
+
+    def mark_down(self, rid):
+        with self._health:
+            with self._route:
+                self.version += 1
+
+    def pick(self):
+        with self._health:          # same order everywhere
+            with self._route:
+                return self.version
+
+    def reload(self, v):
+        with self._health:
+            with self._route:
+                self.version = v
+
+    def dispatch(self, fut, model, batch):
+        out = model.forward(batch)
+        fut.set_result(out)
+        with self.run_lock:
+            self._swap()
+
+    def _swap(self):
+        pass

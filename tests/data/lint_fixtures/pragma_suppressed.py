@@ -1,0 +1,12 @@
+"""A violation carrying a line pragma WITH a reason, plus a file-wide
+allowance: both forms of the suppression contract."""
+# graftlint: allow=typos(fixture exercising the file-wide allowance form)
+import os
+
+
+def get_interals():
+    return None
+
+
+def peek():
+    return os.environ.get("MXNET_TRAIN_WINDOW")  # graftlint: allow=env-registry(fixture: deliberate raw read exercising the line-pragma form)

@@ -1,0 +1,8 @@
+"""Seeded telemetry-catalog violations: dynamic + unconventional names."""
+from mxnet_tpu import telemetry as _tm
+
+
+def record(op, n):
+    _tm.counter(f"serving.{op}").inc(n)       # BAD: dynamic name
+    _tm.counter("TotalRequests").inc()        # BAD: not sub.system.name
+    _tm.gauge("queue").set(n)                 # BAD: no subsystem segment

@@ -1,0 +1,28 @@
+"""Seeded trace-purity violations inside a jitted function and a loop
+body handed to lax.fori_loop."""
+import os
+import random
+import time
+
+import jax
+
+_seen = []
+
+
+@jax.jit
+def impure_step(x):
+    t = time.time()                    # BAD: wall-clock frozen into trace
+    noise = random.random()            # BAD: host RNG draw baked in
+    if os.environ.get("MXNET_FOO"):    # BAD: config pinned at trace time
+        x = x + 1
+    print("tracing", x)                # BAD: trace-time-only effect
+    _seen.append(x)
+    return x * t + noise
+
+
+def window(x0):
+    def body(i, carry):
+        _seen[0] = carry               # BAD: mutates closed-over state
+        return carry + i
+
+    return jax.lax.fori_loop(0, 4, body, x0)

@@ -1,0 +1,26 @@
+"""Clean counterpart: pure traced bodies; jax.random is allowed, and the
+host effects happen OUTSIDE the traced function."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure_step(x, key):
+    noise = jax.random.normal(key, x.shape)   # fine: traced RNG
+    return x * 2.0 + noise
+
+
+def window(x0):
+    def body(i, carry):
+        local = carry + i                     # locals are fine
+        return local
+
+    return jax.lax.fori_loop(0, 4, body, x0)
+
+
+def timed_dispatch(x, key):
+    tic = time.time()                         # fine: outside the trace
+    out = pure_step(x, key)
+    return out, time.time() - tic

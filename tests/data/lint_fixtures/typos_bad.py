@@ -1,0 +1,10 @@
+"""Seeded transcription tells."""
+
+
+def get_interals(symbol):           # BAD: the reference's typo, preserved
+    interals = symbol.get_internals()
+    return interals.list_outputs()
+
+
+def recieve_frame(sock, lenght):    # BAD: two more known tells
+    return sock.recv(lenght)

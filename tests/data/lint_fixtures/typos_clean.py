@@ -1,0 +1,10 @@
+"""Clean counterpart."""
+
+
+def get_internals(symbol):
+    internals = symbol.get_internals()
+    return internals.list_outputs()
+
+
+def receive_frame(sock, length):
+    return sock.recv(length)

@@ -1,0 +1,258 @@
+"""graftlint: the tier-1 gate (zero non-baselined findings on the tree)
+plus the analyzer's own contract tests — every checker proves it fires on
+a seeded violation and stays quiet on the clean counterpart, pragmas
+suppress (and malformed pragmas are themselves findings), and the
+baseline round-trips deterministically.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis.checkers.host_sync import HOT_PATHS
+
+pytestmark = pytest.mark.lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "data", "lint_fixtures")
+BASELINE = os.path.join(ROOT, "tools", "lint_baseline.json")
+
+CHECKERS = [c.name for c in analysis.all_checkers()]
+_FIXTURE_NAME = {  # checker name -> fixture stem
+    "host-sync": "host_sync",
+    "trace-purity": "trace_purity",
+    "env-registry": "env_registry",
+    "telemetry-catalog": "telemetry_catalog",
+    "lock-discipline": "lock_discipline",
+    "typos": "typos",
+}
+
+
+def _lint(files, baseline=None, checks=None):
+    return analysis.run_suite(ROOT, files=files, baseline=baseline,
+                              checks=checks)
+
+
+def _fixture(stem, flavor):
+    path = os.path.join(FIXTURES, f"{stem}_{flavor}.py")
+    assert os.path.exists(path), f"missing fixture {path}"
+    return path
+
+
+# --------------------------------------------------------------------------
+# the gate: the live tree carries zero non-baselined findings
+# --------------------------------------------------------------------------
+
+def test_tree_has_zero_new_findings():
+    result = analysis.run_suite(
+        ROOT, baseline=analysis.load_baseline(BASELINE))
+    rendered = "\n".join(f.render() for f in result.findings)
+    assert not result.findings, (
+        f"graftlint found {len(result.findings)} new finding(s) — fix "
+        "them, add a pragma with a reason, or (last resort) regenerate "
+        f"the baseline:\n{rendered}"
+    )
+
+
+def test_baseline_entries_still_hit():
+    """A baseline entry whose finding was fixed must be removed — a stale
+    baseline could silently absorb a NEW finding with the same key."""
+    result = analysis.run_suite(
+        ROOT, baseline=analysis.load_baseline(BASELINE))
+    assert not result.stale_baseline, (
+        "stale baseline entries (fixed findings still grandfathered): "
+        f"{result.stale_baseline} — run tools/lint.py --write-baseline"
+    )
+
+
+def test_hot_path_table_matches_tree():
+    """Every declared hot-path qualname must resolve to a real function —
+    otherwise a rename silently removes the invariant from coverage."""
+    from mxnet_tpu.analysis.core import iter_defs
+
+    for rel, quals in HOT_PATHS.items():
+        full = os.path.join(ROOT, rel)
+        with open(full, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=rel)
+        present = {q for q, _cls, _fn in iter_defs(tree)}
+        missing = set(quals) - present
+        assert not missing, (
+            f"{rel}: declared hot paths not found: {sorted(missing)} "
+            "(renamed? update HOT_PATHS in analysis/checkers/host_sync.py)"
+        )
+
+
+# --------------------------------------------------------------------------
+# per-checker fixtures: seeded violation fires, clean counterpart passes
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("check", CHECKERS)
+def test_checker_fires_on_seeded_violation(check):
+    bad = _fixture(_FIXTURE_NAME[check], "bad")
+    result = _lint([bad], checks=[check])
+    hits = [f for f in result.findings if f.check == check]
+    assert hits, f"{check} did not fire on its seeded violation fixture"
+    for f in hits:
+        assert f.path.endswith(f"{_FIXTURE_NAME[check]}_bad.py")
+        assert f.line > 0 and f.message
+
+
+@pytest.mark.parametrize("check", CHECKERS)
+def test_checker_passes_clean_fixture(check):
+    clean = _fixture(_FIXTURE_NAME[check], "clean")
+    result = _lint([clean])  # ALL checkers: clean means clean
+    rendered = "\n".join(f.render() for f in result.findings)
+    assert not result.findings, (
+        f"clean fixture for {check} produced findings:\n{rendered}")
+
+
+def test_trace_purity_catches_each_impurity_kind():
+    bad = _fixture("trace_purity", "bad")
+    result = _lint([bad], checks=["trace-purity"])
+    messages = " | ".join(f.message for f in result.findings)
+    for needle in ("wall-clock", "RNG", "trace time", "closed-over"):
+        assert needle in messages, (
+            f"expected a {needle!r} finding in: {messages}")
+
+
+def test_lock_discipline_catches_each_rule():
+    bad = _fixture("lock_discipline", "bad")
+    result = _lint([bad], checks=["lock-discipline"])
+    messages = " | ".join(f.message for f in result.findings)
+    for needle in ("cycle", "written", "run lock"):
+        assert needle in messages, (
+            f"expected a {needle!r} finding in: {messages}")
+
+
+# --------------------------------------------------------------------------
+# pragmas
+# --------------------------------------------------------------------------
+
+def test_pragma_suppresses_file_and_line_scoped():
+    path = os.path.join(FIXTURES, "pragma_suppressed.py")
+    result = _lint([path])
+    assert not result.findings, (
+        "pragma-carrying fixture still reports: "
+        + "; ".join(f.render() for f in result.findings))
+    suppressed = {f.check for f in result.suppressed}
+    assert {"typos", "env-registry"} <= suppressed
+
+
+def test_malformed_pragma_is_itself_a_finding():
+    path = os.path.join(FIXTURES, "pragma_malformed.py")
+    result = _lint([path])
+    pragma_findings = [f for f in result.findings if f.check == "pragma"]
+    assert len(pragma_findings) == 2
+    messages = " | ".join(f.message for f in pragma_findings)
+    assert "no reason" in messages
+    assert "unknown check" in messages
+    # and the underlying env-registry findings are NOT suppressed
+    assert any(f.check == "env-registry" for f in result.findings)
+
+
+def test_pragma_quoted_in_docstring_is_inert():
+    src = '"""Docs may quote `# graftlint: allow=typos(reason)`."""\n'
+    tmp = os.path.join(FIXTURES, "..", "_tmp_docstring.py")
+    tmp = os.path.abspath(tmp)
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(src + "interals = 1\n")
+        result = _lint([tmp])
+        assert any(f.check == "typos" for f in result.findings), (
+            "docstring-quoted pragma must not suppress anything")
+        assert not any(f.check == "pragma" for f in result.findings)
+    finally:
+        os.unlink(tmp)
+
+
+# --------------------------------------------------------------------------
+# baseline round-trip
+# --------------------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    bad = _fixture("typos", "bad")
+    first = _lint([bad])
+    assert first.findings
+    bl_path = str(tmp_path / "baseline.json")
+    analysis.write_baseline(first.findings, bl_path)
+
+    second = _lint([bad], baseline=analysis.load_baseline(bl_path))
+    assert not second.findings, "baselined findings reported as new"
+    assert len(second.baselined) == len(first.findings)
+    assert not second.stale_baseline
+
+    # fixing one finding makes its baseline entry stale (reported)
+    clean = _fixture("typos", "clean")
+    third = _lint([clean], baseline=analysis.load_baseline(bl_path))
+    assert not third.findings
+    assert third.stale_baseline
+
+
+def test_baseline_is_deterministic(tmp_path):
+    bad = _fixture("lock_discipline", "bad")
+    findings = _lint([bad]).findings
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    analysis.write_baseline(findings, a)
+    analysis.write_baseline(list(reversed(findings)), b)
+    with open(a, "rb") as fa, open(b, "rb") as fb:
+        assert fa.read() == fb.read(), "baseline bytes depend on order"
+    data = json.load(open(a))
+    for entry in data["findings"]:
+        assert "line" not in entry, "baseline must be line-number free"
+        assert not os.path.isabs(entry["path"])
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def _run_cli(args):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint.py")] + args,
+        capture_output=True, text=True, cwd=ROOT, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+def test_cli_exit_codes_and_json():
+    bad = _fixture("typos", "bad")
+    proc = _run_cli([bad, "--format=json", "--no-baseline"])
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["findings"] and all(
+        f["check"] == "typos" for f in report["findings"])
+
+    clean = _fixture("typos", "clean")
+    proc = _run_cli([clean, "--format=json", "--no-baseline"])
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["findings"] == []
+
+
+def test_cli_tree_is_green():
+    """The committed tree + committed baseline must satisfy the CLI the
+    way CI invokes it (this is the per-PR gate's exact spelling)."""
+    proc = _run_cli([])
+    assert proc.returncode == 0, (
+        f"python tools/lint.py failed:\n{proc.stdout}\n{proc.stderr}")
+
+
+def test_cli_does_not_import_the_framework():
+    """Linting must work without jax: the CLI loads the self-contained
+    analysis package, never mxnet_tpu itself (a broken venv must still
+    be able to lint)."""
+    probe = (
+        "import sys, runpy\n"
+        "sys.argv = ['lint.py', '--list']\n"
+        "runpy.run_path(r'%s', run_name='__main__')\n"
+    ) % os.path.join(ROOT, "tools", "lint.py")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "sys.modules['jax'] = None  # any jax import now explodes\n"
+         + probe],
+        capture_output=True, text=True, cwd=ROOT, timeout=120)
+    assert "host-sync" in proc.stdout, proc.stderr

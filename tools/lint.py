@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""graftlint CLI — run the framework-invariant static-analysis suite.
+
+Usage:
+    python tools/lint.py                     # lint the tree, text report
+    python tools/lint.py --format=json       # machine-readable report
+    python tools/lint.py --check host-sync   # one checker only
+    python tools/lint.py --write-baseline    # grandfather current findings
+    python tools/lint.py path/to/file.py ... # lint specific files
+
+Exit status: 0 when the tree is clean (no findings beyond the baseline),
+1 when new findings exist, 2 on usage errors. ``--write-baseline``
+regenerates ``tools/lint_baseline.json`` deterministically (sorted,
+path-relative, line-number free) so its diffs are reviewable.
+
+The analysis package is loaded standalone (it is stdlib-only and uses
+relative imports exclusively), so linting works without importing the
+framework or jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BASELINE = os.path.join(_ROOT, "tools", "lint_baseline.json")
+
+
+def _load_analysis():
+    # import the self-contained package as top-level `analysis` — pulling
+    # it in as mxnet_tpu.analysis would execute mxnet_tpu/__init__ and
+    # drag jax into a pure static-analysis CLI
+    sys.path.insert(0, os.path.join(_ROOT, "mxnet_tpu"))
+    try:
+        import analysis
+    finally:
+        sys.path.pop(0)
+    return analysis
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="lint.py", description="graftlint static-analysis suite")
+    p.add_argument("paths", nargs="*",
+                   help="files to lint (default: the framework scope)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--check", action="append", dest="checks",
+                   metavar="NAME", help="run only this checker "
+                   "(repeatable); see --list")
+    p.add_argument("--list", action="store_true",
+                   help="list checkers and exit")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="record current findings as the baseline")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report baselined findings as new")
+    p.add_argument("--root", default=_ROOT, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    analysis = _load_analysis()
+
+    if args.list:
+        for c in analysis.all_checkers():
+            print(f"{c.name:20s} {c.doc}")
+        return 0
+
+    known = set(analysis.checker_names())
+    for c in args.checks or ():
+        if c not in known:
+            p.error(f"unknown checker {c!r} (have: {sorted(known)})")
+
+    files = None
+    if args.paths:
+        if args.write_baseline:
+            p.error("--write-baseline regenerates the TREE-wide baseline "
+                    "and cannot be combined with explicit paths (it would "
+                    "silently drop every other file's entries)")
+        files = [os.path.abspath(f) for f in args.paths]
+    baseline = None if (args.no_baseline or args.write_baseline) \
+        else analysis.load_baseline(_BASELINE)
+    result = analysis.run_suite(args.root, files=files, checks=args.checks,
+                                baseline=baseline)
+
+    if args.write_baseline:
+        analysis.write_baseline(result.findings, _BASELINE)
+        print(f"baseline written: {_BASELINE} "
+              f"({len(result.findings)} findings)")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [dict(f.as_dict(), line=f.line)
+                         for f in result.findings],
+            "baselined": len(result.baselined),
+            "suppressed": len(result.suppressed),
+            "stale_baseline": result.stale_baseline,
+        }, indent=2, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(f.render())
+        print(f"graftlint: {len(result.findings)} new finding(s), "
+              f"{len(result.baselined)} baselined, "
+              f"{len(result.suppressed)} pragma-suppressed")
+        if result.stale_baseline:
+            print(f"note: {len(result.stale_baseline)} baseline entr"
+                  f"{'y is' if len(result.stale_baseline) == 1 else 'ies are'}"
+                  " no longer hit — shrink the baseline "
+                  "(--write-baseline)")
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
