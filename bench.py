@@ -177,6 +177,14 @@ def _time_warm_start(mx, models, batch_size, image, dtype, num_layers,
     return round(time.time() - tic, 3)
 
 
+def _maybe_mesh(record, mx):
+    """Attach the operative GraftMesh layout (MXNET_MESH or an installed
+    mesh) so a bench record is attributable to its parallelism config."""
+    gm = mx.parallel.current_graft()
+    if gm is not None:
+        record["mesh"] = gm.spec
+
+
 def _maybe_mfu(record, img_per_sec, jax, on_tpu, num_layers, dtype):
     """Attach model-FLOPs-utilization when the peak is known for this
     device kind (ResNet-50@224 bf16 only; see the peak table)."""
@@ -520,6 +528,7 @@ def main():
             "telemetry": snapshot,
         }
         _maybe_mfu(record, img_per_sec, jax, on_tpu, num_layers, dtype)
+        _maybe_mesh(record, mx)
         window_k = mx.telemetry.gauge("fit.train_window_k").value
         if window_k:
             record["train_window_k"] = window_k
@@ -639,6 +648,7 @@ def main():
         record["nonfinite_guard_overhead"] = round(
             1.0 - guard_rate / img_per_sec, 4)
     _maybe_mfu(record, img_per_sec, jax, on_tpu, num_layers, dtype)
+    _maybe_mesh(record, mx)
     print(json.dumps(record))
 
 

@@ -104,7 +104,9 @@ def _source_digest():
 
 def _fingerprint():
     """Environment half of every cache key: an executable is only valid for
-    the exact compiler + backend topology that produced it."""
+    the exact compiler + backend topology that produced it — including the
+    configured mesh layout (``MXNET_MESH``): a dp2,pp4 program and a dp8
+    program share neither partitioning nor collectives."""
     import jax
     import jaxlib
 
@@ -115,6 +117,7 @@ def _fingerprint():
         _CACHE_FORMAT, __version__, jax.__version__, jaxlib.__version__,
         _source_digest(), jax.default_backend(), len(devs),
         getattr(devs[0], "device_kind", ""),
+        str(_env.get("MXNET_MESH") or ""),
     )
 
 

@@ -324,6 +324,22 @@ _declare("MXNET_SERVING_WATCH", float, 0.0,
          "checkpoint, ModelServer hot-reloads the weights atomically "
          "between batches without dropping in-flight requests. 0 "
          "(default) = no watching.")
+_declare("MXNET_MESH", str, "",
+         "Default device-mesh layout every module family binds against "
+         "when no mesh is explicitly installed (parallel.with_mesh): axis "
+         "tokens <name><size> joined by ',' or 'x', axes dp/tp/pp/sp — "
+         "e.g. 'dp2,pp4' runs GPipe stages over pp rank sets of 2 "
+         "data-parallel devices each, 'dp2,tp2,pp2' nests tensor "
+         "parallelism inside them. One axis may give '*' (or omit its "
+         "size) to absorb all remaining devices; 'auto' = every visible "
+         "device on dp. Built once per process (GraftMesh.from_env); an "
+         "explicitly installed mesh always wins. Empty (default) = no "
+         "implicit mesh (single device, or a dp mesh over the Context "
+         "list).")
+_declare("MXNET_MESH_BACKEND", str, "",
+         "jax backend whose devices back the MXNET_MESH mesh (e.g. 'cpu' "
+         "to lay a virtual validation mesh over host cores while a TPU "
+         "is attached). Empty (default) = the default backend.")
 _declare("MXNET_XLA_TPU_OPTIONS", str, "",
          "Comma-separated key=value XLA compiler options attached to every "
          "executor program when the target is a TPU (ignored on CPU). The "

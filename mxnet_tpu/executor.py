@@ -680,21 +680,50 @@ class Executor:
             self._sym_sha_cache = sha
         return sha
 
+    @staticmethod
+    def _mesh_token(mesh):
+        """Process-stable rendering of an ambient/scheduled mesh for cache
+        digests (None when no mesh). Mesh *objects* have no cross-process
+        identity; the GraftMesh spec + concrete device assignment does."""
+        from .parallel.mesh import as_graft
+
+        gm = as_graft(mesh)
+        return None if gm is None else gm.cache_token()
+
+    def _shardings_token(self):
+        """Deterministic rendering of the bound input shardings, or None
+        when a sharding kind can't be rendered stably (then the program
+        must not persist)."""
+        out = []
+        for n in sorted(self._in_shardings):
+            s = self._in_shardings[n]
+            spec = getattr(s, "spec", None)
+            smesh = getattr(s, "mesh", None)
+            if spec is None or smesh is None:  # not a NamedSharding
+                return None
+            out.append((n, str(spec), self._mesh_token(smesh)))
+        return tuple(out)
+
     def _aot_digest(self, cache_key):
         """Persistent-cache digest for a jit program, or None when it must
-        not persist: cache off, ambient mesh or sharded inputs (mesh
-        objects have no process-stable identity and sharded executables
-        are topology-bound in ways the fingerprint doesn't capture), or
-        interpret modes (their "programs" are python closures)."""
+        not persist: cache off, un-renderable shardings, or interpret
+        modes (their "programs" are python closures). Mesh-sharded
+        programs persist keyed by the mesh spec + device assignment — the
+        GraftMesh cache token joins the signature, so a warm process on
+        the same topology (same MXNET_MESH / installed spec) rebinds with
+        zero XLA compiles and a different layout never false-hits."""
         if not _aot.cache_enabled():
             return None
-        if cache_key[-1] is not None or self._in_shardings or \
-                self._node2dev or self._naive:
+        if self._node2dev or self._naive:
+            return None
+        shard_tok = self._shardings_token()
+        if shard_tok is None:
             return None
         opts = _tpu_compiler_options(self._ctx)
         dev = self._ctx.jax_device()
         return _aot.digest(
-            "jit", self._sym_sha(), cache_key[:-1], self.graph.remat,
+            "jit", self._sym_sha(), cache_key[:-1],
+            self._mesh_token(cache_key[-1]), shard_tok, self.graph.remat,
             dev.platform, getattr(dev, "device_kind", ""),
             tuple(sorted(opts.items())) if opts else (),
         )
@@ -709,18 +738,20 @@ class Executor:
         hyperparameters are traced inputs."""
         if not _aot.cache_enabled():
             return None
-        if self._in_shardings or self._node2dev or self._naive:
+        if self._node2dev or self._naive:
+            return None
+        shard_tok = self._shardings_token()
+        if shard_tok is None:
             return None
         (update_names, cache_token, with_hg, state_td, has_handles,
          sched_mesh, n_steps, stack_names, guard_on, publish) = plan_key
-        if sched_mesh is not None:
-            return None
         opts = _tpu_compiler_options(self._ctx)
         dev = self._ctx.jax_device()
         return _aot.digest(
             "fused", self._sym_sha(), self._jit_signature(),
             (update_names, cache_token, with_hg, repr(state_td),
              has_handles, n_steps, stack_names, guard_on, publish),
+            self._mesh_token(sched_mesh), shard_tok,
             auto_layout, self.graph.remat, dev.platform,
             getattr(dev, "device_kind", ""),
             tuple(sorted(opts.items())) if opts else (),
@@ -1582,7 +1613,12 @@ class Executor:
 
                 jit_kw = {}
                 plan_auto = False
-                if (sched_mesh is None and _is_tpu_ctx(self._ctx)
+                # single-device only: an installed mesh (sched_mesh) OR
+                # mesh-derived input shardings (the MXNET_MESH env path
+                # binds NamedShardings with current_mesh() still None)
+                # must not be forced onto a SingleDeviceSharding layout
+                if (sched_mesh is None and not self._in_shardings
+                        and _is_tpu_ctx(self._ctx)
                         and _env.get("MXNET_WINDOW_AUTO_LAYOUT")):
                     # compiler-chosen buffer layouts: inside the window
                     # loop the default (major-to-minor) parameter layouts

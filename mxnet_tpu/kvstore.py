@@ -339,31 +339,28 @@ class DistKVStore(KVStore):
 
     # --- cross-process data plane --------------------------------------
     def _leader_mesh(self):
-        """1-D mesh over one device per process — the reduction topology.
+        """The collective layer's GraftMesh: a ``dp`` axis over one device
+        per process — the reduction topology.
 
         The reference reduces per-key on parameter servers over ZMQ
         (kvstore_dist.h Push_/ZPush); here the reduction is one XLA
         collective over ICI/DCN: each process contributes its locally
         merged value as a shard of a global array, a jitted sum over the
-        process axis all-reduces it, and every host reads back the
-        replicated result.
+        ``dp`` axis all-reduces it, and every host reads back the
+        replicated result. Binding the same mesh abstraction the executor
+        uses keeps the whole distributed surface on one topology type.
         """
         if getattr(self, "_mesh", None) is None:
             import jax
-            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-            leaders = []
-            seen = set()
-            for d in self._jax.devices():
-                if d.process_index not in seen:
-                    seen.add(d.process_index)
-                    leaders.append(d)
-            self._mesh = Mesh(leaders, ("p",))
+            from .parallel.mesh import process_leader_mesh
+
+            self._mesh = process_leader_mesh()
             # one jitted reducer per mesh — a fresh lambda per push would
             # miss the pjit fastpath and retrace every step
             self._reducer = jax.jit(
                 lambda a: a.sum(0),
-                out_shardings=NamedSharding(self._mesh, P()),
+                out_shardings=self._mesh.replicated(),
             )
         return self._mesh
 
@@ -371,19 +368,18 @@ class DistKVStore(KVStore):
         """Sum an NDArray's value across all processes; returns jax array."""
         import jax
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         if self.num_workers == 1:
             return value._data
-        mesh = self._leader_mesh()
+        gm = self._leader_mesh()
         my_leader = next(
-            d for d in mesh.devices.flat if d.process_index == self.rank
+            d for d in gm.devices.flat if d.process_index == self.rank
         )
         local = jnp.asarray(value._data)[None]
         local = jax.device_put(local, my_leader)
         garr = jax.make_array_from_single_device_arrays(
             (self.num_workers,) + tuple(value.shape),
-            NamedSharding(mesh, P("p")),
+            gm.batch_sharding(),
             [local],
         )
         return self._reducer(garr).addressable_data(0)

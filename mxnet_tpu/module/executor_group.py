@@ -92,28 +92,22 @@ class DataParallelExecutorGroup:
         self._dp_size = 1
         from ..parallel import mesh as _meshmod
 
-        cur = _meshmod.current_mesh()
-        if cur is not None:
-            # an installed named mesh (with_mesh) takes precedence over the
-            # context list: batch shards over its 'dp' axis (if any), params
-            # replicate unless a __shard__ annotation splits them (tensor
-            # parallelism, parallel/tensor_parallel.py)
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            self._mesh = cur
-            dp = "dp" if "dp" in cur.axis_names else None
-            self._data_sharding = NamedSharding(cur, P(dp))
-            self._param_sharding = NamedSharding(cur, P())
-            self._dp_size = cur.shape[dp] if dp else 1
-        elif len(self.contexts) > 1:
-            import jax
-            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-            devices = np.array([c.jax_device() for c in self.contexts])
-            self._mesh = Mesh(devices, ("dp",))
-            self._data_sharding = NamedSharding(self._mesh, P("dp"))
-            self._param_sharding = NamedSharding(self._mesh, P())
-            self._dp_size = len(self.contexts)
+        # one GraftMesh binds the whole module family; precedence:
+        # explicitly installed mesh (with_mesh) > MXNET_MESH environment
+        # spec > the Context list (a pure-dp mesh over those devices, the
+        # reference's multi-context data parallelism). Batch shards over
+        # the 'dp' axis (if any); params replicate unless a __shard__
+        # annotation splits them over 'tp' (parallel/tensor_parallel.py);
+        # a 'pp' axis is driven by SequentialModule's GPipe engine, not
+        # here.
+        gm = _meshmod.current_graft()
+        if gm is None and len(self.contexts) > 1:
+            gm = _meshmod.GraftMesh.from_contexts(self.contexts)
+        if gm is not None:
+            self._mesh = gm
+            self._data_sharding = gm.batch_sharding()
+            self._param_sharding = gm.replicated()
+            self._dp_size = gm.dp
 
         self.bind_exec(data_shapes, label_shapes, shared_group)
 

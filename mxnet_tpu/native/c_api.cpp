@@ -922,6 +922,19 @@ int MXKVStoreFree(KVStoreHandle handle) {
   return 0;
 }
 
+// MXTPU_GUARD_HANDLE_ARRAY tolerates NULL entries (optional-handle
+// arrays), but every kvstore value must be a real NDArray — reject NULL
+// entries up front instead of dereferencing them
+static bool kv_reject_null_vals(NDArrayHandle* vals, uint32_t num) {
+  for (uint32_t i = 0; i < num; ++i) {
+    if (vals[i] == NULL) {
+      mxtpu::g_last_error = "NULL NDArray handle in kvstore vals array";
+      return false;
+    }
+  }
+  return true;
+}
+
 // build the (keys, vals) python lists for a KVStore call (caller owns refs)
 static void kv_keys_vals(const int* keys, NDArrayHandle* vals, uint32_t num,
                          PyObject** kl, PyObject** vl) {
@@ -939,6 +952,7 @@ static int kv_call3(KVStoreHandle handle, const char* fn, uint32_t num,
                     bool with_priority) {
   MXTPU_GUARD_HANDLE(handle);
   MXTPU_GUARD_HANDLE_ARRAY(vals, num);
+  if (!kv_reject_null_vals(vals, num)) return -1;
   MXTPU_API_BEGIN();
   PyObject *kl, *vl;
   kv_keys_vals(keys, vals, num, &kl, &vl);
@@ -950,9 +964,67 @@ static int kv_call3(KVStoreHandle handle, const char* fn, uint32_t num,
   MXTPU_API_END();
 }
 
+// string-key (Ex) variant of kv_keys_vals: keys become python str objects.
+// Returns false (with the python error set) on a key the interpreter
+// rejects (e.g. invalid UTF-8) — the ABI contract is -1 + MXGetLastError,
+// never a NULL smuggled into a list the dispatch then crashes on.
+static bool kv_keys_vals_str(const char** keys, NDArrayHandle* vals,
+                             uint32_t num, PyObject** kl, PyObject** vl) {
+  *kl = PyList_New(num);
+  *vl = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyObject* k = PyUnicode_FromString(keys[i]);
+    if (!k) {
+      Py_DECREF(*kl);
+      Py_DECREF(*vl);
+      return false;
+    }
+    PyList_SET_ITEM(*kl, i, k);
+    Py_INCREF(H(vals[i])->obj);
+    PyList_SET_ITEM(*vl, i, H(vals[i])->obj);
+  }
+  return true;
+}
+
+static int kv_call3_str(KVStoreHandle handle, const char* fn, uint32_t num,
+                        const char** keys, NDArrayHandle* vals, int priority,
+                        bool with_priority) {
+  MXTPU_GUARD_HANDLE(handle);
+  MXTPU_GUARD_HANDLE_ARRAY(vals, num);
+  if (num > 0 && keys == NULL) {
+    mxtpu::g_last_error = "NULL keys array passed to string-key kvstore call";
+    return -1;
+  }
+  if (!kv_reject_null_vals(vals, num)) return -1;
+  MXTPU_API_BEGIN();
+  PyObject *kl, *vl;
+  if (!kv_keys_vals_str(keys, vals, num, &kl, &vl)) break;
+  PyObject* args = with_priority
+      ? Py_BuildValue("(ONNi)", H(handle)->obj, kl, vl, priority)
+      : Py_BuildValue("(ONN)", H(handle)->obj, kl, vl);
+  PyObject* r = capi_call(fn, args);
+  Py_XDECREF(r);
+  MXTPU_API_END();
+}
+
 int MXKVStoreInit(KVStoreHandle handle, uint32_t num, const int* keys,
                   NDArrayHandle* vals) {
   return kv_call3(handle, "kv_init", num, keys, vals, 0, false);
+}
+
+int MXKVStoreInitEx(KVStoreHandle handle, uint32_t num, const char** keys,
+                    NDArrayHandle* vals) {
+  return kv_call3_str(handle, "kv_init", num, keys, vals, 0, false);
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, uint32_t num, const char** keys,
+                    NDArrayHandle* vals, int priority) {
+  return kv_call3_str(handle, "kv_push", num, keys, vals, priority, true);
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, uint32_t num, const char** keys,
+                    NDArrayHandle* vals, int priority) {
+  return kv_call3_str(handle, "kv_pull", num, keys, vals, priority, true);
 }
 
 int MXKVStorePush(KVStoreHandle handle, uint32_t num, const int* keys,

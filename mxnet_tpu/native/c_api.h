@@ -251,6 +251,15 @@ int MXKVStorePush(KVStoreHandle handle, uint32_t num, const int* keys,
                   NDArrayHandle* vals, int priority);
 int MXKVStorePull(KVStoreHandle handle, uint32_t num, const int* keys,
                   NDArrayHandle* vals, int priority);
+/* string-key variants (reference c_api.h MXKVStore*Ex): the later-era
+ * surface where parameters are addressed by name instead of a dense
+ * integer index — what the Module/Gluon trainers actually emit */
+int MXKVStoreInitEx(KVStoreHandle handle, uint32_t num, const char** keys,
+                    NDArrayHandle* vals);
+int MXKVStorePushEx(KVStoreHandle handle, uint32_t num, const char** keys,
+                    NDArrayHandle* vals, int priority);
+int MXKVStorePullEx(KVStoreHandle handle, uint32_t num, const char** keys,
+                    NDArrayHandle* vals, int priority);
 int MXKVStoreGetRank(KVStoreHandle handle, int* out);
 int MXKVStoreGetGroupSize(KVStoreHandle handle, int* out);
 int MXKVStoreGetType(KVStoreHandle handle, const char** out);

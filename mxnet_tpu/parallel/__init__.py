@@ -1,17 +1,27 @@
-"""Parallelism utilities — device meshes and shardings.
+"""Parallelism utilities — the unified device mesh and its shardings.
 
 This is NEW surface relative to the reference (which had no tensor/sequence
-parallelism, SURVEY.md §2.5): mesh construction + named-sharding helpers that
-the executor group, kvstore and multi-host training build on. The mental
-model is the standard TPU recipe: pick a mesh, annotate shardings, let XLA
-insert collectives over ICI/DCN.
+parallelism, SURVEY.md §2.5): one :class:`GraftMesh` abstraction whose
+named axes (``dp``/``tp``/``pp``/``sp``) every module family binds against
+— executor groups shard batches over ``dp``, ``__shard__`` annotations
+split parameters over ``tp``, SequentialModule lowers to the GPipe
+schedule over ``pp`` rank sets, ring attention rides ``sp`` — and the
+composed train steps (dp×pp, dp×tp×pp) that run them together as one
+program. The mental model is the standard TPU recipe: pick a mesh,
+annotate shardings, let XLA insert collectives over ICI/DCN.
 """
 
+from .compat import shard_map, supports_shard_map
 from .mesh import (
+    GraftMesh,
+    as_graft,
+    current_graft,
     current_mesh,
     data_parallel_mesh,
     get_mesh,
     make_mesh,
+    parse_mesh_spec,
+    process_leader_mesh,
     replicate,
     shard_batch,
     with_mesh,

@@ -1,10 +1,30 @@
-"""Device mesh helpers.
+"""The unified device mesh — one ``GraftMesh``, axes ``dp``/``tp``/``pp``/``sp``.
 
-The framework's distributed backbone: every multi-device execution path
-(data-parallel executor groups, the dist kvstore facade, the multi-chip
-dry-run) goes through a ``jax.sharding.Mesh`` built here. Axis names follow
-the scaling-book convention: ``dp`` (data), ``tp`` (tensor), ``pp``
-(pipeline), ``sp`` (sequence).
+Every multi-device execution path binds against a single multi-axis
+:class:`GraftMesh` wrapping one ``jax.sharding.Mesh``: data-parallel
+executor groups shard the batch over ``dp``, ``__shard__`` annotations
+split parameters over ``tp``, ``SequentialModule`` lowers to the GPipe
+schedule over ``pp`` rank *sets* (each pipeline stage spans the dp×tp
+sub-mesh of its rank set), and ring attention rides ``sp``. Composition is
+the point: ``GraftMesh.from_spec("dp2,pp4")`` lays all three kinds of
+parallelism over one device array, the way GSPMD expresses dp/tp/pp as
+sharding annotations on one logical mesh (Xu et al., 2021) and GPipe
+layers pipeline stages over data-parallel replicas (Huang et al., 2019).
+
+Construction happens once, from one of (highest precedence first):
+
+* an explicitly installed mesh — ``with_mesh(make_mesh({...}))`` or
+  ``with_mesh(GraftMesh.from_spec("dp2,tp2,pp2"))``;
+* the environment — ``MXNET_MESH="dp2,pp4"`` (axis tokens ``<name><size>``,
+  ``*`` or a missing size on ONE axis = all remaining devices; ``auto`` =
+  every visible device on ``dp``), resolved lazily by the first executor
+  group that binds without an installed mesh;
+* the Context list handed to ``Module(context=[...])`` — a pure-``dp``
+  mesh over those devices (the reference's multi-context data parallelism).
+
+Telemetry: ``parallel.mesh_build`` counts constructions; the
+``parallel.mesh_dp``/``mesh_tp``/``mesh_pp``/``mesh_sp`` gauges report the
+most recently built layout.
 """
 
 from __future__ import annotations
@@ -14,12 +34,232 @@ import threading
 import numpy as np
 
 from ..base import MXNetError
+from .. import telemetry as _tm
 
 _state = threading.local()
 
+#: axes the framework assigns semantics to, in canonical layout order
+#: (slowest-varying first: replicas outermost, pipeline innermost keeps a
+#: stage's dp sub-axis contiguous on the ICI torus)
+MESH_AXES = ("dp", "tp", "pp", "sp")
+
+
+class GraftMesh:
+    """One multi-axis device mesh with named-axis semantics.
+
+    Wraps a ``jax.sharding.Mesh`` (``.mesh``) plus the axis metadata every
+    module family binds against. Equality/hash follow the underlying mesh,
+    so re-wrapping the same mesh (``as_graft``) never splits program
+    caches.
+    """
+
+    __slots__ = ("mesh", "spec")
+
+    def __init__(self, jax_mesh, spec=None):
+        self.mesh = getattr(jax_mesh, "mesh", jax_mesh)
+        self.spec = spec or ",".join(
+            f"{name}{size}" for name, size in self.mesh.shape.items()
+        )
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def axis_names(self):
+        return self.mesh.axis_names
+
+    @property
+    def shape(self):
+        return self.mesh.shape
+
+    @property
+    def devices(self):
+        return self.mesh.devices
+
+    def has(self, axis):
+        return axis in self.mesh.axis_names
+
+    def size(self, axis):
+        """Degree of ``axis`` (1 when the mesh doesn't carry it)."""
+        return int(self.mesh.shape[axis]) if self.has(axis) else 1
+
+    @property
+    def dp(self):
+        return self.size("dp")
+
+    @property
+    def tp(self):
+        return self.size("tp")
+
+    @property
+    def pp(self):
+        return self.size("pp")
+
+    @property
+    def sp(self):
+        return self.size("sp")
+
+    def __eq__(self, other):
+        if isinstance(other, GraftMesh):
+            return self.mesh == other.mesh
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(self.mesh)
+
+    def __repr__(self):
+        return f"GraftMesh({self.spec!r})"
+
+    # -- shardings --------------------------------------------------------
+    def sharding(self, *partition):
+        """``NamedSharding`` of this mesh for a ``PartitionSpec`` (given as
+        spec entries, or a single prebuilt ``PartitionSpec``)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if len(partition) == 1 and isinstance(partition[0], P):
+            return NamedSharding(self.mesh, partition[0])
+        return NamedSharding(self.mesh, P(*partition))
+
+    def batch_sharding(self):
+        """Dim-0 (batch) sharded over ``dp`` — replicated without one."""
+        return self.sharding("dp" if self.has("dp") else None)
+
+    def replicated(self):
+        return self.sharding()
+
+    def cache_token(self):
+        """Process-stable identity for executable cache keys: the axis
+        layout plus the concrete device assignment (ids are stable for a
+        fixed topology; mesh *objects* are not stable across processes)."""
+        return (
+            self.spec,
+            tuple(int(d.id) for d in self.mesh.devices.flat),
+            getattr(self.mesh.devices.flat[0], "platform", ""),
+        )
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_axes(cls, axis_sizes, devices=None, backend=None):
+        """Build from ``{axis: size}`` (see :func:`make_mesh`)."""
+        return cls(make_mesh(axis_sizes, devices=devices, backend=backend))
+
+    @classmethod
+    def from_spec(cls, spec, devices=None, backend=None):
+        """Build from a layout string: ``"dp2,pp4"``, ``"dp2,tp2,pp2"``,
+        ``"pp4"``, ``"auto"`` (all devices on dp). One axis may give ``*``
+        (or omit its size) to absorb every remaining device."""
+        axis_sizes = parse_mesh_spec(spec, devices=devices, backend=backend)
+        return cls.from_axes(axis_sizes, devices=devices, backend=backend)
+
+    @classmethod
+    def from_contexts(cls, contexts):
+        """A pure-dp mesh over a Context list (the reference's multi-device
+        data parallelism, ``Module(context=[...])``)."""
+        devs = [c.jax_device() for c in contexts]
+        return cls.from_axes({"dp": len(devs)}, devices=devs)
+
+    @classmethod
+    def from_env(cls):
+        """The ``MXNET_MESH``-configured mesh, or None when unset. Built
+        once per process (the spec names a fixed topology; rebuilding per
+        bind would churn program caches keyed by mesh identity)."""
+        global _env_mesh, _env_mesh_spec
+        from .. import env as _env
+
+        raw = str(_env.get("MXNET_MESH") or "").strip()
+        if not raw:
+            return None
+        backend = str(_env.get("MXNET_MESH_BACKEND") or "") or None
+        with _env_lock:
+            if _env_mesh is None or _env_mesh_spec != (raw, backend):
+                _env_mesh = cls.from_spec(raw, backend=backend)
+                _env_mesh_spec = (raw, backend)
+            return _env_mesh
+
+
+_env_lock = threading.Lock()
+_env_mesh = None
+_env_mesh_spec = None
+
+
+def _reset_env_mesh():
+    """Drop the cached MXNET_MESH mesh (tests that flip the env var)."""
+    global _env_mesh, _env_mesh_spec
+    with _env_lock:
+        _env_mesh = None
+        _env_mesh_spec = None
+
+
+def parse_mesh_spec(spec, devices=None, backend=None):
+    """Parse a mesh layout string into ``{axis: size}``.
+
+    Tokens are ``<axis><size>`` separated by ``,`` or ``x``; ``<axis>`` is
+    one of ``dp``/``tp``/``pp``/``sp``. Exactly one token may use ``*`` (or
+    omit the size) to mean "all remaining devices". ``"auto"`` is
+    shorthand for ``dp*``.
+    """
+    raw = str(spec).strip().lower()
+    if raw in ("auto", "*"):
+        raw = "dp*"
+    tokens = [t for t in raw.replace("x", ",").split(",") if t.strip()]
+    if not tokens:
+        raise MXNetError(f"empty mesh spec {spec!r}")
+    sizes = {}
+    wildcard = None
+    for tok in tokens:
+        tok = tok.strip()
+        name = tok.rstrip("0123456789*")
+        if name not in MESH_AXES:
+            raise MXNetError(
+                f"unknown mesh axis {name!r} in spec {spec!r} "
+                f"(axes: {'/'.join(MESH_AXES)})"
+            )
+        if name in sizes or name == wildcard:
+            raise MXNetError(f"duplicate axis {name!r} in mesh spec {spec!r}")
+        tail = tok[len(name):]
+        if tail in ("", "*"):
+            if wildcard is not None:
+                raise MXNetError(
+                    f"two wildcard axes in mesh spec {spec!r}; at most one "
+                    "axis may absorb the remaining devices"
+                )
+            wildcard = name
+            continue
+        if not tail.isdigit():
+            raise MXNetError(
+                f"bad size {tail!r} for axis {name!r} in mesh spec "
+                f"{spec!r}; want <axis><int>, <axis>* or <axis>"
+            )
+        size = int(tail)
+        if size < 1:
+            raise MXNetError(f"axis {name!r} has size {size} in {spec!r}")
+        sizes[name] = size
+    if wildcard is not None:
+        if devices is None:
+            import jax
+
+            devices = jax.devices(backend)
+        fixed = int(np.prod(list(sizes.values()))) if sizes else 1
+        rest, rem = divmod(len(devices), fixed)
+        if rest < 1:
+            raise MXNetError(
+                f"mesh spec {spec!r} needs {fixed} devices before the "
+                f"wildcard axis but only {len(devices)} are visible"
+            )
+        if rem:
+            # the wildcard promises to absorb EVERY remaining device; a
+            # silent floor would leave `rem` devices idle
+            raise MXNetError(
+                f"mesh spec {spec!r}: {len(devices)} devices do not divide "
+                f"by the fixed axes' product {fixed}; the wildcard axis "
+                f"would strand {rem} device(s)"
+            )
+        sizes[wildcard] = rest
+    # canonical layout order regardless of spec order (dp outermost)
+    return {a: sizes[a] for a in MESH_AXES if a in sizes}
+
 
 def make_mesh(axis_sizes, devices=None, backend=None):
-    """Create a Mesh with named axes, e.g. make_mesh({'dp': 4, 'tp': 2}).
+    """Create a raw ``jax.sharding.Mesh`` with named axes, e.g.
+    ``make_mesh({'dp': 4, 'tp': 2})``.
 
     Uses all visible devices by default; ``backend="cpu"`` selects that
     backend's devices (e.g. the virtual CPU mesh used to validate multi-chip
@@ -39,7 +279,12 @@ def make_mesh(axis_sizes, devices=None, backend=None):
             f"mesh of size {total} exceeds {len(devices)} visible devices"
         )
     arr = np.array(devices[:total]).reshape(sizes)
-    return Mesh(arr, names)
+    mesh = Mesh(arr, names)
+    _tm.counter("parallel.mesh_build").inc()
+    for axis in MESH_AXES:
+        _tm.gauge(f"parallel.mesh_{axis}").set(  # graftlint: allow=telemetry-catalog(literal family parallel.mesh_{dp,tp,pp,sp} enumerated by MESH_AXES; all four catalogued in docs/observability.md)
+            int(axis_sizes.get(axis, 0)))
+    return mesh
 
 
 def data_parallel_mesh(num_devices=None):
@@ -50,8 +295,34 @@ def data_parallel_mesh(num_devices=None):
     return make_mesh({"dp": n}, devs)
 
 
+def process_leader_mesh():
+    """A ``dp`` GraftMesh over one device per process — the reduction
+    topology of the dist kvstore's collective layer (each process
+    contributes its locally merged value; one psum over ``dp`` is the
+    cross-host all-reduce)."""
+    import jax
+
+    leaders = []
+    seen = set()
+    for d in jax.devices():
+        if d.process_index not in seen:
+            seen.add(d.process_index)
+            leaders.append(d)
+    return GraftMesh.from_axes({"dp": len(leaders)}, devices=leaders)
+
+
+def as_graft(mesh):
+    """Normalize to a :class:`GraftMesh` (None passes through). Raw
+    ``jax.sharding.Mesh`` objects are wrapped with a derived spec — the
+    wrapper compares/hashes like its mesh, so repeated wrapping is
+    cache-transparent."""
+    if mesh is None or isinstance(mesh, GraftMesh):
+        return mesh
+    return GraftMesh(mesh)
+
+
 def with_mesh(mesh):
-    """Context manager installing a current mesh."""
+    """Context manager installing a current mesh (GraftMesh or raw Mesh)."""
 
     class _Ctx:
         def __enter__(self):
@@ -67,7 +338,19 @@ def with_mesh(mesh):
 
 
 def current_mesh():
+    """The installed mesh exactly as given to :func:`with_mesh` (raw Mesh
+    or GraftMesh), or None. Internal consumers normalize via
+    :func:`current_graft`."""
     return getattr(_state, "mesh", None)
+
+
+def current_graft():
+    """The installed mesh as a GraftMesh, falling back to the
+    ``MXNET_MESH`` environment mesh; None when neither is configured."""
+    m = current_mesh()
+    if m is not None:
+        return as_graft(m)
+    return GraftMesh.from_env()
 
 
 def get_mesh():
@@ -81,11 +364,11 @@ def shard_batch(mesh, axis="dp"):
     """NamedSharding splitting dim 0 over the given mesh axis."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    return NamedSharding(mesh, P(axis))
+    return NamedSharding(getattr(mesh, "mesh", mesh), P(axis))
 
 
 def replicate(mesh):
     """NamedSharding replicating across the whole mesh."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    return NamedSharding(mesh, P())
+    return NamedSharding(getattr(mesh, "mesh", mesh), P())

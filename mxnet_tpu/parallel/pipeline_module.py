@@ -28,6 +28,22 @@ Two lowerings, picked automatically:
   homogeneity. Gradients come back sharded the same way (only ``dp``
   contributions are summed).
 
+Composed meshes (``dp×pp``, ``dp×tp×pp``): a ``dp`` axis places each GPipe
+stage on a pp rank *set* — the batch shards over ``dp`` inside every
+microbatch, and in composed mode the packed rows additionally shard their
+flat dim over the stage's (dp, tp) sub-mesh, so each device holds
+~``total/(S·dp·tp)`` packed parameter bytes (ZeRO-style: rows are
+``all_gather``-ed over the rank set at program entry, and the gather's AD
+transpose is exactly the gradient ``psum_scatter`` over the ``dp``
+sub-axis *within* each stage's rank set — the reduce-scatter form of the
+per-stage data-parallel gradient sum). BatchNorm-style aux updates are
+``pmean``-ed over ``dp`` (mean of per-shard batch statistics = full-batch
+means, the serial semantics). A ``tp`` axis nests inside stages: tp ranks
+hold distinct packed-row shards; stage compute replicates over tp on
+runtimes whose SPMD partitioner cannot nest GSPMD-auto regions inside
+manual collectives (jax 0.4.x hard-aborts there), while ``__shard__``
+Megatron shardings ride the pure-jit executor path (dp×tp) unchanged.
+
 Scope (enforced with clear errors): every child is a plain bound
 ``Module`` with one data input, interior boundaries are single tensors of
 one shared shape/dtype, and only the last child takes labels. More
@@ -43,7 +59,12 @@ BN behavior); fill/drain ticks contribute nothing.
 
 from __future__ import annotations
 
+import math
+
 from ..base import MXNetError
+from .. import telemetry as _tm
+from .compat import shard_map as _shard_map
+from .mesh import as_graft
 
 
 def _graph_signature(graph, data_names, label_names, shape_of):
@@ -153,8 +174,9 @@ class PipelineEngine:
     def __init__(self, stages, mesh, num_microbatches, batch_size, logger):
         from ..env import get as env_get
 
-        self.mesh = mesh
-        self.S = int(mesh.shape["pp"])
+        self.gmesh = as_graft(mesh)
+        self.mesh = self.gmesh.mesh
+        self.S = self.gmesh.pp
         if self.S < 2:
             raise MXNetError("a pp mesh axis of size 1 pipelines nothing; "
                              "drop the pp axis or grow it")
@@ -163,6 +185,13 @@ class PipelineEngine:
                 f"{len(stages)} pipeline children for a pp axis of size "
                 f"{self.S}; need at least one child per stage"
             )
+        # composed-mesh degrees: each GPipe stage is placed on a pp rank
+        # SET spanning the dp×tp sub-mesh; packed rows shard over it
+        self.dp_size = self.gmesh.dp
+        self.tp_size = self.gmesh.tp
+        self._row_axes = tuple(a for a in ("dp", "tp")
+                               if self.gmesh.has(a))
+        self._row_shard = self.dp_size * self.tp_size
         self.infos = _build_stages(stages, self.S)
         self.M = int(num_microbatches or env_get("MXNET_PP_MICROBATCHES")
                      or self.S)
@@ -170,6 +199,12 @@ class PipelineEngine:
             raise MXNetError(
                 f"batch {batch_size} not divisible into {self.M} "
                 "microbatches"
+            )
+        if (batch_size // self.M) % self.dp_size != 0:
+            raise MXNetError(
+                f"microbatch {batch_size // self.M} not divisible by the "
+                f"data-parallel degree {self.dp_size} (mesh "
+                f"{self.gmesh.spec})"
             )
         self.logger = logger
         shapes = set()
@@ -249,23 +284,37 @@ class PipelineEngine:
             per_stage.append(rows)
         dtypes = sorted(dtypes)
         lmax = {}
+        # lane-align AND keep the flat dim divisible by the stage rank
+        # set's shard degree (rows shard over the dp×tp sub-mesh)
+        align = 128 * self._row_shard // math.gcd(128, self._row_shard)
         for dt in dtypes:
             longest = max((st[dt][0] for st in per_stage if dt in st),
                           default=0)
-            lmax[dt] = max(128, -(-longest // 128) * 128)  # lane-align
+            lmax[dt] = max(align, -(-longest // align) * align)
         return {"dtypes": dtypes, "per_stage": per_stage, "lmax": lmax,
                 "n_entries": [len(info.aux_entries if is_aux
                                   else info.param_entries)
                               for info in self.infos]}
 
+    def _row_spec_entry(self):
+        """The PartitionSpec entry sharding a packed row's flat dim over
+        the stage rank set's dp×tp sub-mesh (None on a pure-pp mesh)."""
+        if not self._row_axes:
+            return None
+        return self._row_axes if len(self._row_axes) > 1 \
+            else self._row_axes[0]
+
     def _pack_rows(self, vals_per_stage, layout):
         """Eager: stack per-stage flat rows into {dtype: (S, Lmax)} arrays
-        placed P('pp') so each pipeline rank holds only its stage's row."""
+        placed P('pp', <dp×tp>) — each pipeline rank set holds only its
+        stage's row, and within the rank set each device holds a 1/(dp·tp)
+        slice of it (~total/(S·dp·tp) packed bytes per device)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         out = {}
+        nbytes = 0
         for dt in layout["dtypes"]:
             rows = []
             for i in range(self.S):
@@ -282,7 +331,11 @@ class PipelineEngine:
                             else parts[0])
             buf = jnp.stack(rows)
             out[dt] = jax.device_put(
-                buf, NamedSharding(self.mesh, P("pp")))
+                buf, NamedSharding(self.mesh, P("pp", self._row_spec_entry())))
+            nbytes += buf.size * buf.dtype.itemsize
+        if layout is self._param_layout:
+            _tm.gauge("parallel.packed_bytes_per_device").set(
+                nbytes // (self.S * self._row_shard))
         return out
 
     @staticmethod
@@ -349,9 +402,29 @@ class PipelineEngine:
         mesh, S, M = self.mesh, self.S, self.M
         infos = self.infos
         homogeneous = self.homogeneous
-        dp = "dp" if "dp" in mesh.axis_names else None
+        gm = self.gmesh
+        dp = "dp" if gm.has("dp") else None
+        dp_size = self.dp_size
+        row_axes = self._row_axes
+        row_shard = self._row_shard
+        gather_axes = row_axes if len(row_axes) > 1 else \
+            (row_axes[0] if row_axes else None)
         loss_flags = _head_loss_flags(infos[-1].graph)
         num_heads = len(infos[-1].graph.heads)
+
+        def gather_rows(packed):
+            """ZeRO-style: reassemble this rank set's full packed rows
+            from the (dp, tp)-sharded slices. Differentiable — the AD
+            transpose is psum_scatter over the rank set, i.e. the
+            per-stage gradient reduce-scatter over the dp sub-axis."""
+            if gather_axes is None or homogeneous:
+                return packed
+            return {
+                dt: jax.lax.all_gather(packed[dt], gather_axes, axis=1,
+                                       tiled=True)
+                for dt in packed
+            }
+
         if not homogeneous:
             p_layout, a_layout = self._param_layout, self._aux_layout
             unpack, repack = self._unpack_row, self._repack_row
@@ -397,6 +470,11 @@ class PipelineEngine:
         def sched(pvals, avals, rng, xs, ls):
             s = jax.lax.axis_index("pp")
             key0 = jax.random.PRNGKey(0)
+            # composed rank sets: the body receives (dp, tp)-sharded row
+            # slices; compute needs the full rows of THIS pp rank's stage
+            avals_in = avals
+            pvals = gather_rows(pvals)
+            avals = gather_rows(avals)
 
             def first_stage_out(a):
                 pv = (jax.tree_util.tree_map(lambda v: v[0], pvals)
@@ -567,19 +645,41 @@ class PipelineEngine:
             )
             outs = tuple(jax.lax.psum(o, "pp") for o in outs)
             # average the M masked per-tick updates back into storage
-            # dtypes; no cross-rank exchange needed — rank i's rows ARE
+            # dtypes; no cross-pp exchange needed — rank i's rows ARE
             # stage i's aux and the P('pp') out spec reassembles them.
-            # Eval returns the INPUT aux bit-exact (BN aux is inert there).
+            # Under a dp sub-axis the per-rank estimates additionally
+            # average over dp (mean of per-shard BN batch statistics =
+            # the full-batch means, the serial semantics); tp ranks
+            # contribute bit-identical updates, so the same reduction
+            # divided by the rank-set size is exact there too. Eval
+            # returns the INPUT aux bit-exact (BN aux is inert there).
             inv_m = jnp.float32(1.0 / M)
             if not is_train:
-                aux_all = (avals,) if homogeneous else avals
+                aux_all = (avals_in,) if homogeneous else avals_in
             elif homogeneous:
+                acc = aux_acc[0]
+                if dp:
+                    acc = jax.tree_util.tree_map(
+                        lambda a: jax.lax.psum(a, "dp"), acc)
+                inv = jnp.float32(1.0 / (M * (dp_size if dp else 1)))
                 aux_all = (jax.tree_util.tree_map(
-                    lambda acc, ref: (acc * inv_m).astype(ref.dtype),
-                    aux_acc[0], avals),)
+                    lambda a, ref: (a * inv).astype(ref.dtype),
+                    acc, avals),)
+            elif gather_axes is not None:
+                # reduce over the stage's rank set and scatter straight
+                # back to this device's row slice (matches the sharded
+                # out spec); /(M·dp·tp) folds the microbatch average,
+                # the dp mean and the identical-tp-contribution sum
+                inv = jnp.float32(1.0 / (M * row_shard))
+                aux_all = {
+                    dt: (jax.lax.psum_scatter(
+                        aux_acc[dt], gather_axes, scatter_dimension=1,
+                        tiled=True) * inv).astype(avals_in[dt].dtype)
+                    for dt in aux_acc
+                }
             else:
                 aux_all = {
-                    dt: (aux_acc[dt] * inv_m).astype(avals[dt].dtype)
+                    dt: (aux_acc[dt] * inv_m).astype(avals_in[dt].dtype)
                     for dt in aux_acc
                 }
             return outs, aux_all
@@ -608,13 +708,26 @@ class PipelineEngine:
 
             grads, (outs, aux_all) = jax.grad(
                 local_loss, has_aux=True)(pvals)
-            # params are pp-sharded in BOTH modes now (stacked leading axis
-            # or packed per-stage rows): each rank's grad IS its slice, so
-            # only dp contributions sum
-            reduce_axes = ("dp",) if dp else ()
-            if reduce_axes:
-                grads = jax.tree_util.tree_map(
-                    lambda g: jax.lax.psum(g, reduce_axes), grads)
+            # params are pp-sharded in BOTH modes (stacked leading axis or
+            # packed per-stage rows): each rank's grad IS its slice, so
+            # only the dp sub-axis within the stage's rank set sums.
+            # Composed sharded rows get that reduction from AD itself —
+            # the transpose of the in-graph all_gather is psum_scatter
+            # over (dp, tp) — leaving only the identical-tp-contribution
+            # scale to divide out. Stacked (homogeneous) rows replicate
+            # over dp, whose implicit transpose-psum shard_map does not
+            # perform under check_vma=False, so it is spelled out.
+            if homogeneous:
+                if dp:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: jax.lax.psum(g, ("dp",)), grads)
+            elif gather_axes is not None and self.tp_size > 1:
+                inv_tp = jnp.float32(1.0 / self.tp_size)
+                grads = {
+                    dt: (grads[dt].astype(jnp.float32) * inv_tp
+                         ).astype(grads[dt].dtype)
+                    for dt in grads
+                }
             return outs, aux_all, grads
 
         def make_step():
@@ -624,32 +737,35 @@ class PipelineEngine:
                 ls = tuple(l.reshape((M, B // M) + tuple(l.shape[1:]))
                            for l in labels)
                 if homogeneous:
-                    pv_in = jax.tree_util.tree_map(
-                        lambda *leaves: jnp.stack(leaves), *pvals)
-                    av_in = jax.tree_util.tree_map(
-                        lambda *leaves: jnp.stack(leaves), *avals)
-                    p_spec = jax.tree_util.tree_map(lambda _: P("pp"),
-                                                    pv_in)
-                    a_spec = jax.tree_util.tree_map(lambda _: P("pp"),
-                                                    av_in)
-                    aux_out_spec = (jax.tree_util.tree_map(
-                        lambda _: P("pp"), avals[0]),)
-                else:
-                    # packed composed: {dtype: (S, Lmax)} buffers, one row
-                    # per stage, sharded over pp
+                    # stacked EAGERLY by run() (leading axis S, P('pp')):
+                    # producing a multi-axis-mesh shard_map operand inside
+                    # the enclosing jit silently miscompiles on jax-0.4.x
+                    # SPMD (verified against the serial oracle), so the
+                    # program takes the stacked pytrees as real arguments
                     pv_in, av_in = pvals, avals
                     p_spec = jax.tree_util.tree_map(lambda _: P("pp"),
                                                     pv_in)
                     a_spec = jax.tree_util.tree_map(lambda _: P("pp"),
+                                                    av_in)
+                    aux_out_spec = (a_spec,)
+                else:
+                    # packed composed: {dtype: (S, Lmax)} buffers, one row
+                    # per stage sharded over pp, the flat dim sharded over
+                    # the stage rank set's dp×tp sub-mesh (ZeRO-style)
+                    row = self._row_spec_entry()
+                    pv_in, av_in = pvals, avals
+                    p_spec = jax.tree_util.tree_map(lambda _: P("pp", row),
+                                                    pv_in)
+                    a_spec = jax.tree_util.tree_map(lambda _: P("pp", row),
                                                     av_in)
                     aux_out_spec = a_spec
                 x_spec = P(None, dp)
                 out_specs = (tuple(P(None, dp) for _ in range(num_heads)),
                              aux_out_spec)
                 if with_grads:
-                    # param grads stay sharded P('pp') in both modes
+                    # param grads keep the parameter sharding in both modes
                     out_specs = out_specs + (p_spec,)
-                mapped = jax.shard_map(
+                mapped = _shard_map(
                     sched_train if with_grads else sched, mesh=mesh,
                     in_specs=(p_spec, a_spec, P(), x_spec,
                               jax.tree_util.tree_map(lambda _: x_spec, ls)),
@@ -663,27 +779,39 @@ class PipelineEngine:
                               + tuple(o.shape[2:]))
                     for o in outs
                 )
-                if homogeneous:
-                    # aux comes back stacked over pp; unstack to per-stage
-                    aux_back = tuple(
-                        tuple(leaf[i] for leaf in aux_all[0])
-                        for i in range(S)
-                    )
-                else:
-                    aux_back = aux_all
+                # homogeneous aux/grads return STACKED (run() unstacks
+                # host-side — slicing shard_map results inside this jit
+                # risks the same multi-axis SPMD miscompile as stacking)
                 next_rng = jax.random.fold_in(rng, 0x9E3779B9)
                 if not with_grads:
-                    return outs_flat, aux_back, next_rng
-                grads = res[2]
-                if homogeneous:
-                    grads = tuple(
-                        tuple(leaf[i] for leaf in grads)
-                        for i in range(S)
-                    )
-                return outs_flat, aux_back, grads, next_rng
+                    return outs_flat, aux_all, next_rng
+                return outs_flat, aux_all, res[2], next_rng
             return step
 
         return make_step()
+
+    def _stack_stage_vals(self, vals_per_stage):
+        """Eager homogeneous-mode input prep: stack per-stage value tuples
+        on a leading S axis and place P('pp') (stage i's slice on pipeline
+        rank set i)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *vals_per_stage)
+        sh = NamedSharding(self.mesh, P("pp"))
+        return jax.tree_util.tree_map(
+            lambda v: jax.device_put(v, sh), stacked)
+
+    def _unstack_stages(self, tree):
+        """Host-side inverse of :meth:`_stack_stage_vals`: per-stage value
+        tuples from stacked leaves (reads slice per stage — eager, off the
+        traced program)."""
+        return tuple(
+            tuple(leaf[i] for leaf in tree)
+            for i in range(self.S)
+        )
 
     # -- Module-facing API ------------------------------------------------
     def run(self, data_batch, is_train):
@@ -693,14 +821,20 @@ class PipelineEngine:
 
         from ..ndarray import NDArray, array as nd_array
 
+        _tm.counter("parallel.pp_run").inc()
         pvals, avals = self._stage_vals()
         if not self.homogeneous:
             # per-stage placement: stage i's params/aux ride row i of the
-            # packed P('pp') buffers, so each pipeline rank materializes
-            # ~1/S of the parameter bytes inside the program
+            # packed P('pp', dp×tp) buffers, so each device materializes
+            # ~1/(S·dp·tp) of the parameter bytes inside the program
             pvals = self._pack_rows(pvals, self._param_layout)
             avals = self._pack_rows(avals, self._aux_layout)
             self._packed_params = pvals if self.retain_packed else None
+        else:
+            # homogeneous: stacked eagerly here (NOT inside the program —
+            # see the step() comment on the multi-axis SPMD miscompile)
+            pvals = self._stack_stage_vals(pvals)
+            avals = self._stack_stage_vals(avals)
 
         def as_val(a):
             return a._data if isinstance(a, NDArray) else nd_array(a)._data
@@ -725,14 +859,26 @@ class PipelineEngine:
         if self._rng_dev is None:
             self._rng_dev = jax.random.PRNGKey(0)
         with_grads = bool(is_train) and self.has_loss
+        if with_grads and self.dp_size > 1:
+            # the dispatched program reduces gradients over the dp
+            # sub-axis within each stage's rank set (explicit psum for
+            # stacked rows, the all_gather transpose's psum_scatter for
+            # packed rows) — counted so tests can assert composed runs
+            # really carried the reduction
+            _tm.counter("parallel.dp_reduce").inc()
         if with_grads:
             outs, aux_back, grads, self._rng_dev = \
                 self._program(is_train, True)(
                     pvals, avals, self._rng_dev, data_v, tuple(labels))
+            if self.homogeneous:
+                grads = self._unstack_stages(grads)
             self._write_grads(grads)
         else:
             outs, aux_back, self._rng_dev = self._program(is_train, False)(
                 pvals, avals, self._rng_dev, data_v, tuple(labels))
+        if self.homogeneous:
+            # program returns the 1-tuple of stacked aux leaves
+            aux_back = self._unstack_stages(aux_back[0])
         self._write_aux(aux_back)
         for info in self.infos:
             # the children's param/aux snapshots are stale once the engine

@@ -50,9 +50,13 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, pp_axis="pp"):
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    if pp_axis not in mesh.axis_names:
+    from .compat import shard_map
+    from .mesh import as_graft
+
+    mesh = as_graft(mesh)
+    if not mesh.has(pp_axis):
         raise MXNetError(f"mesh has no axis {pp_axis!r}")
-    S = mesh.shape[pp_axis]
+    S = mesh.size(pp_axis)
     M = int(x.shape[0])
     leaves = jax.tree_util.tree_leaves(stage_params)
     for leaf in leaves:
@@ -94,7 +98,7 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, pp_axis="pp"):
         return jax.lax.psum(outs, pp_axis)
 
     spec_params = jax.tree_util.tree_map(lambda _: P(pp_axis), stage_params)
-    return jax.shard_map(
+    return shard_map(
         run, mesh=mesh,
         in_specs=(spec_params, P()),
         out_specs=P(),

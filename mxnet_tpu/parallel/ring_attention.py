@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..base import MXNetError
+from .compat import shard_map as _shard_map, to_varying as _to_varying
 
 
 def _ring_attn_shard(q, k, v, axis_name, causal, scale):
@@ -39,7 +40,7 @@ def _ring_attn_shard(q, k, v, axis_name, causal, scale):
 
     # accumulators are per-device state (varying over the ring axis)
     def _vary(x):
-        return jax.lax.pcast(x, axis_name, to="varying")
+        return _to_varying(x, axis_name)
 
     o = _vary(jnp.zeros((B, H, Tl, D), jnp.float32))
     m = _vary(jnp.full((B, H, Tl), -jnp.inf, jnp.float32))
@@ -103,6 +104,9 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
 
     from jax.sharding import NamedSharding
 
+    from .mesh import as_graft
+
+    mesh = as_graft(mesh).mesh
     sharding = NamedSharding(mesh, _ring_spec(axis, None))
     q = jax.device_put(q, sharding)
     k = jax.device_put(k, sharding)
@@ -137,8 +141,11 @@ def ring_attention_traced(q, k, v, mesh, axis="sp", causal=False,
     instead of being gathered/replicated over the other axes."""
     from jax.sharding import NamedSharding
 
+    from .mesh import as_graft
+
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    mesh = getattr(as_graft(mesh), "mesh", None)
     if mesh is None or axis not in mesh.axis_names:
         return _full_attention(q, k, v, causal, scale)
     if batch_axis is not None and batch_axis not in mesh.axis_names:
@@ -148,13 +155,14 @@ def ring_attention_traced(q, k, v, mesh, axis="sp", causal=False,
     q = jax.lax.with_sharding_constraint(q, sharding)
     k = jax.lax.with_sharding_constraint(k, sharding)
     v = jax.lax.with_sharding_constraint(v, sharding)
-    return jax.shard_map(
+    return _shard_map(
         functools.partial(
             _ring_attn_shard, axis_name=axis, causal=causal, scale=scale
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=True,
     )(q, k, v)
 
 

@@ -105,10 +105,14 @@ def collect_shard_specs(symbol):
 
 
 def shard_spec_sharding(mesh, spec, ndim):
-    """NamedSharding for (mesh_axis, dim) over ``mesh``; replicated when the
-    dim is outside the array's rank (biases under a layer-wide scope)."""
+    """NamedSharding for (mesh_axis, dim) over ``mesh`` (GraftMesh or raw
+    Mesh); replicated when the dim is outside the array's rank (biases
+    under a layer-wide scope)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from .mesh import as_graft
+
+    mesh = as_graft(mesh).mesh
     axis, dim = spec
     if axis not in mesh.axis_names:
         raise MXNetError(
@@ -145,6 +149,9 @@ def tp_mlp(x, w1, w2, mesh, tp_axis="tp", dp_axis=None):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from .mesh import as_graft
+
+    mesh = as_graft(mesh).mesh
     if tp_axis not in mesh.axis_names:
         raise MXNetError(f"mesh has no axis {tp_axis!r}")
     if dp_axis is not None and dp_axis not in mesh.axis_names:

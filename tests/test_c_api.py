@@ -400,6 +400,27 @@ def test_c_api_kvstore_recordio_dataiter(amalgamated, tmp_path):
     assert lib.MXNDArraySyncCopyToCPU(
         out_h, got.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(2)) == 0
     np.testing.assert_array_equal(got, [2.0, 5.0])
+
+    # --- string-key (Ex) trio on the same store: names address entries
+    # independently of the int keyspace (roadmap-5b ledger slice)
+    skeys = (ctypes.c_char_p * 2)(b"fc1_weight", b"fc1_bias")
+    sinit = (ctypes.c_void_p * 2)(make_nd([1.0, 2.0]), make_nd([3.0, 4.0]))
+    assert lib.MXKVStoreInitEx(kv, 2, skeys, sinit) == 0, \
+        lib.MXGetLastError()
+    spush = (ctypes.c_void_p * 2)(make_nd([10.0, 20.0]),
+                                  make_nd([30.0, 40.0]))
+    assert lib.MXKVStorePushEx(kv, 2, skeys, spush, 0) == 0, \
+        lib.MXGetLastError()
+    souts = [make_nd([0.0, 0.0]), make_nd([0.0, 0.0])]
+    spull = (ctypes.c_void_p * 2)(*souts)
+    assert lib.MXKVStorePullEx(kv, 2, skeys, spull, 0) == 0, \
+        lib.MXGetLastError()
+    for h_out, want in zip(souts, ([10.0, 20.0], [30.0, 40.0])):
+        sgot = np.zeros(2, np.float32)
+        assert lib.MXNDArraySyncCopyToCPU(
+            h_out, sgot.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_size_t(2)) == 0
+        np.testing.assert_array_equal(sgot, want)
     assert lib.MXKVStoreFree(kv) == 0
 
     # --- RecordIO roundtrip through the C surface

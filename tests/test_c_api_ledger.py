@@ -90,6 +90,32 @@ def test_out_of_scope_bucket_is_honest():
         f"headers — move to the implemented bucket: {sorted(lying)}")
 
 
+def test_string_key_kvstore_trio_is_implemented():
+    """ROADMAP 5b slice: the string-key KVStore surface moved from the
+    out-of-scope bucket into the implemented one — the Ex names must be
+    ledgered implemented, declared with ``const char**`` keys, and backed
+    by a real dispatch in c_api.cpp (not just a declaration)."""
+    trio = {"MXKVStoreInitEx", "MXKVStorePushEx", "MXKVStorePullEx"}
+    impl = set(_read_names("c_api_implemented.txt"))
+    oos = set(_read_names("c_api_out_of_scope.txt"))
+    assert trio <= impl, f"trio not ledgered implemented: {sorted(trio - impl)}"
+    assert not (trio & oos), "trio still ledgered out-of-scope"
+
+    with open(os.path.join(_NATIVE, "c_api.h")) as f:
+        header = f.read()
+    for name in sorted(trio):
+        m = re.search(rf"\b{name}\s*\(([^;]*)\)\s*;", header)
+        assert m, f"{name} not declared in c_api.h"
+        assert "const char**" in re.sub(r"\s+", " ", m.group(1)), (
+            f"{name} must take `const char** keys`, got: {m.group(1)}")
+
+    with open(os.path.join(_NATIVE, "c_api.cpp")) as f:
+        impl_src = f.read()
+    for name in sorted(trio):
+        assert re.search(rf"\bint {name}\s*\(", impl_src), (
+            f"{name} declared but not defined in c_api.cpp")
+
+
 def test_header_extensions_are_known():
     """Names we declare beyond the reference surface are deliberate,
     enumerated extensions — a new one must be added here consciously (or
