@@ -83,6 +83,14 @@ the JSON tail reports ``availability`` (completed/total across
 pre/fault/recover phases — pinned >= 0.99 in the cpu smoke),
 ``p99_during_fault_ms``, the failover count, and the killed replica's
 final state (probe-recovered or still open).
+
+``BENCH_MODE=ckpt`` times the CHECKPOINT save pause on the training
+thread: two identical fit passes with per-epoch + mid-epoch v2 sharded
+saves — synchronous, then ``MXNET_CKPT_ASYNC``-style async — reporting
+per-save ``snapshot_us`` / ``write_us`` / ``write_async_us``, the
+resulting ``pause_us`` each mode charges the training loop, and
+``async_vs_sync_pause`` (the bounded-stall win; ``BENCH_CKPT_EPOCHS``
+sizes the pass).
 """
 # graftlint: allow=env-registry(bench drives the framework's declared MXNET_* knobs and chaos injection by writing/restoring os.environ by design — the sweep and chaos legs ARE env manipulation)
 
@@ -465,6 +473,83 @@ def _run_serve_mode(mx, models, image, num_layers, on_tpu):
     print(json.dumps(record))
 
 
+def _ckpt_pass(mx, models, batch_size, image, dtype, num_layers, on_tpu,
+               epochs, ckpt_dir, async_write):
+    """One fit pass with per-epoch + mid-epoch saves; returns the
+    per-save training-thread pause decomposition from telemetry."""
+    mod = _build_module(mx, models, batch_size, image, dtype, num_layers,
+                        on_tpu)
+    rng = np.random.RandomState(0)
+    n = batch_size * 4
+    data = rng.uniform(-1, 1, (n,) + image).astype(mx.base.np_dtype(dtype))
+    label = rng.randint(0, 1000, (n,)).astype(np.float32)
+    train = mx.io.NDArrayIter(data, label, batch_size=batch_size,
+                              last_batch_handle="discard")
+    cfg = mx.CheckpointConfig(ckpt_dir, period=1, batch_period=2,
+                              keep_n=2, async_write=async_write)
+    saves0 = mx.telemetry.counter("checkpoint.save").value
+    bytes0 = mx.telemetry.counter("checkpoint.bytes").value
+    marks = {}
+    for h in ("checkpoint.snapshot", "checkpoint.write",
+              "checkpoint.write_async"):
+        hist = mx.telemetry.histogram(h)
+        marks[h] = (hist.count, hist.sum)
+    t0 = time.time()
+    mod.fit(train, num_epoch=epochs,
+            optimizer_params={"learning_rate": 0.01, "momentum": 0.9},
+            checkpoint=cfg)
+    wall_s = time.time() - t0
+    saves = mx.telemetry.counter("checkpoint.save").value - saves0
+    out = {"saves": saves, "wall_s": round(wall_s, 3),
+           "bytes": mx.telemetry.counter("checkpoint.bytes").value - bytes0}
+    for h, (c0, s0) in marks.items():
+        hist = mx.telemetry.histogram(h)
+        dc, ds = hist.count - c0, hist.sum - s0
+        out[h.split(".", 1)[1] + "_us"] = round(ds / dc, 1) if dc else 0.0
+    # the training thread stalls for snapshot always, plus the write only
+    # when synchronous; async commits ride the writer thread
+    out["pause_us"] = round(
+        out["snapshot_us"] + (0.0 if async_write else out["write_us"]), 1)
+    return out
+
+
+def _run_ckpt_mode(mx, models, batch_size, image, dtype, num_layers,
+                   on_tpu):
+    """BENCH_MODE=ckpt: measure what a checkpoint save costs the training
+    thread. Two identical fit passes with per-epoch + mid-epoch v2
+    sharded saves — synchronous (pause = snapshot + write) then async
+    (pause = snapshot only; the commit lands on the writer thread) — and
+    report the per-save pause decomposition plus the async/sync ratio.
+    The async pause bound is the elastic-checkpoint contract: growing
+    model size moves write_us, not the training stall."""
+    import shutil
+    import tempfile
+
+    epochs = int(os.environ.get("BENCH_CKPT_EPOCHS", 3))
+    root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        sync = _ckpt_pass(mx, models, batch_size, image, dtype, num_layers,
+                          on_tpu, epochs, os.path.join(root, "sync"),
+                          async_write=False)
+        asy = _ckpt_pass(mx, models, batch_size, image, dtype, num_layers,
+                         on_tpu, epochs, os.path.join(root, "async"),
+                         async_write=True)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    record = {
+        "metric": f"resnet{num_layers}_ckpt_pause"
+                  + ("" if on_tpu else "_cpusmoke"),
+        "value": asy["pause_us"],
+        "unit": "us/save",
+        "sync": sync,
+        "async": asy,
+        "async_vs_sync_pause": round(
+            asy["pause_us"] / sync["pause_us"], 3) if sync["pause_us"]
+        else None,
+    }
+    print(json.dumps(record))
+
+
 def main():
     import jax
 
@@ -485,6 +570,11 @@ def main():
 
     if mode == "serve":
         _run_serve_mode(mx, models, image, num_layers, on_tpu)
+        return
+
+    if mode == "ckpt":
+        _run_ckpt_mode(mx, models, batch_size, image, dtype, num_layers,
+                       on_tpu)
         return
 
     sweep = None

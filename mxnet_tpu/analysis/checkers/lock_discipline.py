@@ -21,7 +21,13 @@ while holding a lock, and reports:
   (``forward``/``run``/``asnumpy``/``wait_to_read``/``block_until_ready``)
   or future resolution (``set_result``/``set_exception``) while holding a
   lock named ``run_lock`` — the single-worker serving loop stalls every
-  queued request for the duration.
+  queued request for the duration;
+- **I/O under an async-writer hand-off lock**: file I/O (``open``/
+  ``savez``/``fsync``/``rename``/...) or device calls while holding a
+  lock named ``*writer_lock`` — the async checkpoint writer's
+  bounded-stall contract says the hand-off lock guards only the pending
+  slot; holding it across a write re-serializes training against the
+  very I/O the writer thread exists to overlap.
 
 Lock identity is ``Class.attr`` for ``self`` locks and module-qualified
 for globals; a lock attribute seen on a foreign receiver (``rep.lock``)
@@ -44,6 +50,8 @@ _LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore",
 _BLOCKING_ATTRS = {"forward", "run", "asnumpy", "wait_to_read",
                    "block_until_ready"}
 _FUTURE_ATTRS = {"set_result", "set_exception"}
+_WRITER_IO_ATTRS = {"savez", "save", "dump", "write", "flush", "fsync",
+                    "rename", "replace", "makedirs", "rmtree"}
 _SKIP_METHODS = {"__init__", "__del__"}
 
 
@@ -312,6 +320,28 @@ class LockDisciplineChecker:
                     f"`.{attr}(...)` while holding the batcher run lock — "
                     "client callbacks run under the lock (resolve futures "
                     "after releasing it)",
+                    context=f"{info.name}.{method.name}"))
+        # I/O or device work under an async-writer hand-off lock: the
+        # bounded-stall contract says *writer_lock guards only the
+        # pending slot — release it before touching files or the device
+        if any(h.endswith("writer_lock") for h in held):
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in _BLOCKING_ATTRS or attr in _WRITER_IO_ATTRS:
+                    findings.append(Finding(
+                        self.name, unit.path, node.lineno,
+                        f"`.{attr}(...)` while holding the writer "
+                        "hand-off lock — the lock guards only the "
+                        "pending slot; do the I/O after releasing it or "
+                        "the training thread stalls behind the write",
+                        context=f"{info.name}.{method.name}"))
+            elif isinstance(node.func, ast.Name) and node.func.id == "open":
+                findings.append(Finding(
+                    self.name, unit.path, node.lineno,
+                    "`open(...)` while holding the writer hand-off lock "
+                    "— the lock guards only the pending slot; do the I/O "
+                    "after releasing it or the training thread stalls "
+                    "behind the write",
                     context=f"{info.name}.{method.name}"))
 
     def _note_write(self, info, method, node, held):

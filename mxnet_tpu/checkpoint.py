@@ -1,4 +1,4 @@
-"""Crash-consistent checkpointing and auto-resume.
+"""Crash-consistent, mesh-elastic checkpointing and auto-resume.
 
 The reference framework's recovery story is launcher-level whole-job
 restart (ps-lite dead-node detection, ``src/kvstore/kvstore_dist.h:177-185``
@@ -14,30 +14,53 @@ fault tolerance:
   (``model.save_checkpoint``, ``Module.save_checkpoint``,
   ``callback.do_checkpoint``) routes through it.
 
-* **Manifested checkpoints** — :class:`CheckpointManager` writes one
-  *directory* per checkpoint: params, optimizer state, symbol JSON and a
-  ``manifest.json`` (epoch/batch cursor, per-file sha256 digests, RNG key,
-  optimizer update counts, environment fingerprint). The manifest is
-  written last and the directory is renamed into place, so a checkpoint
-  either exists completely or not at all. A ``LATEST`` pointer file names
-  the newest commit; ``keep_n`` retention prunes old ones.
+* **Mesh-native format v2** — :class:`CheckpointManager` writes one
+  *directory* per checkpoint: per-process shard files holding only the
+  ADDRESSABLE pieces of each parameter (no full-model host gather on one
+  rank), per-rank commit records, a symbol JSON and a ``manifest.json``
+  recording the operative :class:`~mxnet_tpu.parallel.mesh.GraftMesh`
+  identity, per-parameter logical shapes/dtypes/sharding specs, packed
+  pipeline ``stage_slices`` metadata, per-parameter optimizer state
+  templates, per-file sha256 digests, the epoch/batch cursor, RNG key,
+  optimizer update counts and an environment fingerprint. Commits are
+  two-phase under multi-process training: every process leader writes its
+  shard file and commit record behind a barrier fence, THEN rank 0 writes
+  the manifest and renames the directory into place — a mid-save crash on
+  any rank leaves no torn commit. A ``LATEST`` pointer file names the
+  newest commit; ``keep_n`` retention prunes old ones. Format v1
+  directories (replicated single-file params) remain loadable.
 
-* **Digest-verified load with fallback** — :meth:`CheckpointManager.
-  load_latest` verifies every file against the manifest digests; a
-  truncated or corrupted checkpoint is *never* loaded — it is counted
-  (``checkpoint.corrupt``), logged, and the previous manifest-valid
-  checkpoint is used instead (``checkpoint.fallback``).
+* **Elastic restore** — the v2 loader reassembles each logical parameter
+  from ANY source mesh's shard pieces (recorded global-index slices) and
+  hands full host arrays to ``module.set_params``, which re-places them
+  under the CURRENT mesh — dp2,pp4 → dp4,pp2 → dp8 → single device and
+  back, including re-packing into ``pipeline_module``'s packed stage rows
+  (rebuilt from the child executors on the next ``run()``). Optimizer
+  state restores per-parameter (by NAME, not updater index), so it
+  survives topology changes that renumber parameters.
 
-* **Auto-resume** — ``Module.fit(..., checkpoint=CheckpointConfig(dir))``
-  (or ``MXNET_CHECKPOINT_DIR``) saves every ``period`` epochs (and every
-  ``batch_period`` batches mid-epoch) and, on the next fit in a fresh
-  process, resumes epoch / batch cursor / params / optimizer state / RNG
-  from the latest valid checkpoint — so ``tools/launch.py --max-restarts``
-  relaunches continue mid-training instead of from scratch.
+* **Digest-verified load with fallback** — :func:`load_latest` verifies
+  every file against the manifest digests; a truncated or corrupted
+  checkpoint is *never* loaded — it is counted (``checkpoint.corrupt``),
+  logged, and the previous valid checkpoint is used instead
+  (``checkpoint.fallback``).
 
-Multi-host: only rank 0 writes (``dist`` kvstores gate on ``kv.rank``),
-fenced by barriers so no rank races ahead of a commit; every rank loads
-the same checkpoint from the shared directory.
+* **Resume consensus** — under a multi-worker dist kvstore all ranks
+  agree on WHICH commit to resume from: rank 0 verifies and decides,
+  the choice is broadcast through the kvstore
+  (:meth:`CheckpointManager.decide_resume`), and every other rank loads
+  exactly that commit — replacing the per-rank ``load_latest`` that could
+  diverge when a rank raced a mid-commit directory scan.
+
+* **Bounded-stall async snapshot** — with ``MXNET_CKPT_ASYNC=1`` the
+  training pause covers only the device→host snapshot
+  (``checkpoint.snapshot`` span); file writes run on a dedicated writer
+  thread (``checkpoint.write_async`` span) with its own lock discipline:
+  ``_writer_lock`` guards ONLY the hand-off slot, never file I/O.
+
+Multi-host: every process leader writes its own shard file; rank 0 alone
+writes the manifest and ``LATEST``, fenced by barriers so no rank races
+ahead of a commit.
 """
 
 from __future__ import annotations
@@ -48,13 +71,15 @@ import json
 import logging
 import os
 import shutil
+import threading
 
 from . import telemetry as _tm
 from .base import MXNetError
 
 _MANIFEST = "manifest.json"
 _LATEST = "LATEST"
-_FORMAT = 1
+_FORMAT_V1 = 1
+_FORMAT = 2
 
 
 class CheckpointCorrupt(MXNetError):
@@ -162,19 +187,26 @@ class CheckpointConfig:
     resume : bool
         Resume from the latest valid checkpoint at fit start
         (default True).
+    async_write : bool or None
+        Run file writes on a dedicated writer thread so the training
+        pause covers only the device→host snapshot (None = consult
+        ``MXNET_CKPT_ASYNC``). Forced off under a multi-worker dist
+        kvstore (the two-phase commit is barrier-fenced).
     """
 
     __slots__ = ("dir", "period", "keep_n", "batch_period",
-                 "save_optimizer", "resume")
+                 "save_optimizer", "resume", "async_write")
 
     def __init__(self, dir, period=1, keep_n=3, batch_period=0,
-                 save_optimizer=True, resume=True):
+                 save_optimizer=True, resume=True, async_write=None):
         self.dir = os.fspath(dir)
         self.period = max(1, int(period))
         self.keep_n = max(0, int(keep_n))
         self.batch_period = max(0, int(batch_period))
         self.save_optimizer = bool(save_optimizer)
         self.resume = bool(resume)
+        self.async_write = async_write if async_write is None \
+            else bool(async_write)
 
     @staticmethod
     def from_env():
@@ -210,18 +242,23 @@ class CheckpointConfig:
 
 
 class LoadedCheckpoint:
-    """A verified checkpoint, ready to resume from."""
+    """A verified checkpoint, ready to resume from.
+
+    ``opt_states_by_name`` is the v2 per-parameter optimizer state map
+    ``{param_name: numpy pytree}`` (None for v1 checkpoints, which carry
+    one opaque updater blob at ``opt_states_path`` instead)."""
 
     __slots__ = ("path", "manifest", "arg_params", "aux_params",
-                 "opt_states_path")
+                 "opt_states_path", "opt_states_by_name")
 
     def __init__(self, path, manifest, arg_params, aux_params,
-                 opt_states_path):
+                 opt_states_path, opt_states_by_name=None):
         self.path = path
         self.manifest = manifest
         self.arg_params = arg_params
         self.aux_params = aux_params
         self.opt_states_path = opt_states_path
+        self.opt_states_by_name = opt_states_by_name
 
     @property
     def next_epoch(self):
@@ -230,6 +267,142 @@ class LoadedCheckpoint:
     @property
     def next_batch(self):
         return int(self.manifest["next_batch"])
+
+
+# --- module introspection helpers -------------------------------------------
+
+def _leaf_modules(mod):
+    """The executor-owning modules under ``mod``: a SequentialModule's
+    children (recursively), else the module itself. Child executors are
+    the single source of truth for both params and optimizer state —
+    pipeline_module rebuilds its packed rows from them every run()."""
+    kids = getattr(mod, "_children", None)
+    if callable(kids):
+        out = []
+        for m in kids():
+            out.extend(_leaf_modules(m))
+        return out
+    return [mod]
+
+
+def _module_param_names(m):
+    eg = getattr(m, "_exec_group", None)
+    return list(eg.param_names) if eg is not None else []
+
+
+def _module_updater(m):
+    """The updater holding ``m``'s optimizer state (kvstore-side when
+    update_on_kvstore, module-side otherwise); None when absent."""
+    if getattr(m, "_update_on_kvstore", False) and \
+            getattr(m, "_kvstore", None) is not None:
+        return m._kvstore._updater
+    return getattr(m, "_updater", None)
+
+
+def _device_param_arrays(mod):
+    """``({name: jax.Array}, {name: jax.Array})`` for args and auxes,
+    read straight from the executors — the save path never gathers the
+    full model to one host; it only iterates addressable shards."""
+    args, auxs = {}, {}
+    for m in _leaf_modules(mod):
+        eg = getattr(m, "_exec_group", None)
+        ex = getattr(eg, "_exec", None) if eg is not None else None
+        if ex is None:
+            a, b = m.get_params()
+            for k, v in a.items():
+                args[k] = getattr(v, "_data", v)
+            for k, v in b.items():
+                auxs[k] = getattr(v, "_data", v)
+            continue
+        for n in eg.param_names:
+            if n in ex.arg_dict:
+                args[n] = ex.arg_dict[n]._data
+        for n in getattr(eg, "aux_names", ()):
+            if n in ex.aux_dict:
+                auxs[n] = ex.aux_dict[n]._data
+    return args, auxs
+
+
+def _process_index():
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _sharding_spec_str(garr):
+    spec = getattr(getattr(garr, "sharding", None), "spec", None)
+    return None if spec is None else str(spec)
+
+
+def _full_index(shape):
+    return [[0, int(s)] for s in shape]
+
+
+def _index_json(idx, shape):
+    """A shard's global-index slices as ``[[start, stop], ...]``."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _host_pieces(garr, is_writer):
+    """This process's non-redundant pieces of a (possibly sharded,
+    possibly replicated) array as ``[(index_json, numpy), ...]``.
+
+    ``replica_id == 0`` filters each distinct shard to exactly one owner
+    across the whole job; a process-LOCAL array (every device in this
+    process — the dist-kvstore replication regime) is written by the
+    writer rank only, so S identical replicas don't hit the filesystem
+    S times. The ``np.asarray`` per piece IS the device→host copy."""
+    import numpy as np
+
+    data = getattr(garr, "_data", garr)
+    shards = getattr(data, "addressable_shards", None)
+    if shards is None:
+        if not is_writer:
+            return []
+        arr = np.asarray(data)
+        return [(_full_index(arr.shape), arr)]
+    me = _process_index()
+    try:
+        local_only = all(
+            getattr(d, "process_index", 0) == me
+            for d in data.sharding.device_set)
+    except Exception:
+        local_only = True
+    if local_only and not is_writer:
+        return []
+    out = []
+    for sh in sorted(shards, key=lambda s: getattr(s.device, "id", 0)):
+        if sh.replica_id != 0:
+            continue
+        out.append((_index_json(sh.index, data.shape), np.asarray(sh.data)))
+    return out
+
+
+def _mesh_entry():
+    """The operative GraftMesh's identity for the manifest (None when no
+    mesh/jax is available — pure file-tool use)."""
+    try:
+        from .parallel.mesh import current_graft
+
+        return current_graft().manifest_entry()
+    except Exception:
+        return None
+
+
+def _stage_slices_of(mod):
+    eng = getattr(mod, "_pp_engine", None)
+    if eng is None:
+        return None
+    fn = getattr(eng, "stage_slices", None)
+    return fn() if callable(fn) else None
 
 
 # --- the manager ------------------------------------------------------------
@@ -249,14 +422,13 @@ class CheckpointManager:
         self.logger = logger or logging.getLogger("mxnet_tpu.checkpoint")
         self._saves = 0
         self._batch_mark = (None, 0)  # (epoch, nbatch at last batch save)
+        self._async_writer = None
 
     # -- rank gating ---------------------------------------------------
     def attach(self, module, kvstore=None):
         self.module = module
         self.kvstore = kvstore
-        if (self.config.batch_period and kvstore is not None
-                and "dist" in getattr(kvstore, "type", "")
-                and getattr(kvstore, "num_workers", 1) > 1):
+        if (self.config.batch_period and self._dist_multi_worker()):
             # mid-epoch saves are barrier-fenced collectives; ranks can
             # tick nbatch asymmetrically (adaptive per-rank window depth,
             # uneven shards), and a rank calling save() when its peers
@@ -270,6 +442,11 @@ class CheckpointManager:
                 "checkpointing at epoch boundaries only")
             self.config.batch_period = 0
 
+    def _dist_multi_worker(self):
+        kv = self.kvstore
+        return (kv is not None and "dist" in getattr(kv, "type", "")
+                and getattr(kv, "num_workers", 1) > 1)
+
     def _is_writer(self):
         kv = self.kvstore
         if kv is not None and "dist" in getattr(kv, "type", ""):
@@ -282,6 +459,38 @@ class CheckpointManager:
         kv = self.kvstore
         if kv is not None and "dist" in getattr(kv, "type", ""):
             kv.barrier()
+
+    def _async_enabled(self):
+        """Off-thread file writes: opt-in (config or MXNET_CKPT_ASYNC),
+        forced off under a multi-worker dist kvstore — the two-phase
+        commit needs every rank inside the barrier fence."""
+        on = self.config.async_write
+        if on is None:
+            from . import env as _env
+
+            on = bool(_env.get("MXNET_CKPT_ASYNC"))
+        if on and self._dist_multi_worker():
+            if self._async_writer is None:  # warn once
+                self.logger.warning(
+                    "checkpoint: MXNET_CKPT_ASYNC disabled under a "
+                    "multi-worker dist kvstore (the two-phase commit is "
+                    "barrier-fenced); saves run synchronously")
+            self.config.async_write = False
+            return False
+        return bool(on)
+
+    def _writer(self):
+        if self._async_writer is None:
+            self._async_writer = _AsyncCheckpointWriter(self)
+        return self._async_writer
+
+    def finalize(self):
+        """Drain and stop the async writer (fit calls this in a finally;
+        idempotent)."""
+        w = self._async_writer
+        if w is not None:
+            w.close()
+            self._async_writer = None
 
     # -- periodic hooks (called from Module.fit) -----------------------
     def epoch_tick(self, epoch):
@@ -306,18 +515,25 @@ class CheckpointManager:
             self.save(next_epoch=epoch, next_batch=nbatch,
                       epoch=epoch, nbatch=nbatch)
 
-    # -- save ----------------------------------------------------------
+    # -- save: snapshot ------------------------------------------------
     def _collect_optimizer_meta(self):
-        opt = getattr(self.module, "_optimizer", None)
-        if opt is None:
+        leaves = [m for m in _leaf_modules(self.module or object())
+                  if getattr(m, "_optimizer", None) is not None]
+        if not leaves:
             return None
+        opt = leaves[0]._optimizer
+        update_count = {}
+        for m in leaves:
+            names = _module_param_names(m)
+            for k, v in getattr(m._optimizer,
+                                "_index_update_count", {}).items():
+                nm = names[k] if isinstance(k, int) and k < len(names) \
+                    else str(k)
+                update_count[nm] = int(v)
         return {
             "num_update": int(getattr(opt, "num_update", 0)),
             "begin_num_update": int(getattr(opt, "begin_num_update", 0)),
-            "index_update_count": {
-                str(k): int(v)
-                for k, v in getattr(opt, "_index_update_count", {}).items()
-            },
+            "update_count": update_count,
         }
 
     def _rng_state(self):
@@ -328,116 +544,283 @@ class CheckpointManager:
         except Exception:
             return None
 
+    def _snapshot(self, next_epoch, next_batch, epoch, nbatch):
+        """Everything one save needs, as host numpy + JSON-able metadata:
+        the only training pause. After this returns, no device array (or
+        live module state) is referenced — the write can run off-thread."""
+        mod = self.module
+        cfg = self.config
+        kv = self.kvstore
+        rank = getattr(kv, "rank", 0) if kv is not None else 0
+        is_writer = self._is_writer()
+        args, auxs = _device_param_arrays(mod)
+        params_meta = {}
+        pieces = []
+        for kind, d in (("arg", args), ("aux", auxs)):
+            for name in sorted(d):
+                garr = d[name]
+                params_meta[name] = {
+                    "kind": kind,
+                    "shape": [int(s) for s in garr.shape],
+                    "dtype": str(garr.dtype),
+                    "spec": _sharding_spec_str(garr),
+                }
+                for ordinal, (index, data) in enumerate(
+                        _host_pieces(garr, is_writer)):
+                    pieces.append({
+                        "key": f"{kind}:{name}@{rank}#{ordinal}",
+                        "name": name, "domain": "param",
+                        "index": index, "data": data,
+                    })
+        opt_templates = None
+        opt_pieces = []
+        opt_meta = None
+        if cfg.save_optimizer and getattr(mod, "optimizer_initialized",
+                                          False):
+            opt_templates, opt_pieces = self._opt_snapshot(rank, is_writer)
+            opt_meta = self._collect_optimizer_meta()
+        sym = getattr(mod, "symbol", None)
+        return {
+            "name": f"ckpt-e{next_epoch:05d}-b{next_batch:08d}",
+            "rank": rank,
+            "next_epoch": int(next_epoch), "next_batch": int(next_batch),
+            "epoch": epoch, "nbatch": nbatch,
+            "params": params_meta,
+            "pieces": pieces,
+            "opt_templates": opt_templates,
+            "opt_pieces": opt_pieces,
+            "opt_meta": opt_meta,
+            "mesh": _mesh_entry(),
+            "stage_slices": _stage_slices_of(mod),
+            "symbol_json": sym.tojson() if sym is not None else None,
+            "rng": self._rng_state(),
+            "env": _env_fingerprint(),
+        }
+
+    def _opt_snapshot(self, rank, is_writer):
+        """Per-parameter optimizer state as (templates, pieces): each
+        updater state pytree is flattened to a JSON template whose array
+        leaves become shard pieces keyed ``opt:<name>#<leaf>`` — restore
+        is by NAME, so a topology change that renumbers updater indices
+        cannot misassign momentum."""
+        templates = {}
+        pieces = []
+        for m in _leaf_modules(self.module):
+            upd = _module_updater(m)
+            if upd is None:
+                continue
+            names = _module_param_names(m)
+            for idx, state in upd.states.items():
+                name = names[idx] if isinstance(idx, int) and \
+                    idx < len(names) else str(idx)
+                counter = [0]
+
+                def conv(v):
+                    if v is None:
+                        return None
+                    if isinstance(v, (list, tuple)):
+                        return [conv(x) for x in v]
+                    data = getattr(v, "_data", None)
+                    if data is None:
+                        return {"value": v}
+                    i = counter[0]
+                    counter[0] += 1
+                    node = {"leaf": i,
+                            "shape": [int(s) for s in data.shape],
+                            "dtype": str(data.dtype)}
+                    for ordinal, (index, arr) in enumerate(
+                            _host_pieces(data, is_writer)):
+                        pieces.append({
+                            "key": f"opt:{name}#{i}@{rank}#{ordinal}",
+                            "name": name, "leaf": i, "domain": "opt",
+                            "index": index, "data": arr,
+                        })
+                    return node
+
+                templates[name] = conv(state)
+        return templates, pieces
+
+    # -- save: commit --------------------------------------------------
     def save(self, next_epoch, next_batch, epoch=None, nbatch=None):
         """Commit one crash-consistent checkpoint at resume position
         ``(next_epoch, next_batch)``. All ranks call this (it fences);
-        only the writer rank touches the filesystem. Returns the committed
-        directory path on the writer, None elsewhere."""
+        every rank writes its own shard file, rank 0 alone commits the
+        manifest. Returns the committed directory path on the writer
+        (for async saves, the path it WILL commit), None elsewhere."""
         self._fence()
+        with _tm.span("checkpoint.snapshot"):
+            snap = self._snapshot(next_epoch, next_batch, epoch, nbatch)
+        root = self.config.dir
+        tmp_shared = os.path.join(root, f".tmp-{snap['name']}")
         out = None
-        if self._is_writer():
-            out = self._write(next_epoch, next_batch, epoch, nbatch)
+        if self._dist_multi_worker():
+            # two-phase commit: (1) every rank durably writes its shard
+            # file + commit record into a shared tmp dir, fenced; (2)
+            # rank 0 unions the records into the manifest and renames.
+            # A crash anywhere leaves either no tmp dir or an unrenamed
+            # one — never a torn ckpt-* directory.
+            if self._is_writer():
+                os.makedirs(root, exist_ok=True)
+                if os.path.exists(tmp_shared):
+                    shutil.rmtree(tmp_shared)
+                os.makedirs(tmp_shared)
+            self._fence()
+            with _tm.span("checkpoint.write"):
+                self._write_rank_files(tmp_shared, snap)
+            self._fence()  # phase 1 complete on every rank
+            if self._is_writer():
+                with _tm.span("checkpoint.write"):
+                    out = self._commit(tmp_shared, snap)
+        elif self._async_enabled():
+            self._writer().submit(snap)
+            out = os.path.join(root, snap["name"])
+        else:
+            with _tm.span("checkpoint.write"):
+                out = self._write_local(snap)
         self._fence()
         return out
 
-    def _write(self, next_epoch, next_batch, epoch, nbatch):
-        from .ndarray import save as nd_save
+    def _write_local(self, snap):
+        """Single-process commit: phase 1 and phase 2 back to back."""
+        root = self.config.dir
+        os.makedirs(root, exist_ok=True)
+        tmp = os.path.join(root, f".tmp-{snap['name']}.{os.getpid()}")
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        self._write_rank_files(tmp, snap)
+        return self._commit(tmp, snap)
 
-        mod = self.module
-        cfg = self.config
-        with _tm.span("checkpoint.write"):
-            arg_params, aux_params = mod.get_params()
-            name = f"ckpt-e{next_epoch:05d}-b{next_batch:08d}"
-            root = cfg.dir
-            os.makedirs(root, exist_ok=True)
-            tmp = os.path.join(root, f".tmp-{name}.{os.getpid()}")
-            if os.path.exists(tmp):
-                shutil.rmtree(tmp)
-            os.makedirs(tmp)
-            files = {}
+    def _write_rank_files(self, tmp, snap):
+        """Phase 1 on every rank: this rank's shard file(s) plus a
+        ``commit-<rank>.json`` record naming them with digests. Shard
+        containers are plain ``.npz`` (numpy-only: the writer thread and
+        the offline tools never touch jax)."""
+        import numpy as np
 
-            save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
-            save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
-            ppath = os.path.join(tmp, "params")
-            nd_save(ppath, save_dict)
-            _fsync_file(ppath)
-            files["params"] = {"sha256": sha256_file(ppath),
-                               "bytes": os.path.getsize(ppath)}
+        from . import faultinject as _fi
 
-            if cfg.save_optimizer and getattr(
-                    mod, "optimizer_initialized", False) and \
-                    hasattr(mod, "save_optimizer_states"):
-                spath = os.path.join(tmp, "optimizer.states")
-                try:
-                    mod.save_optimizer_states(spath)
-                except (AssertionError, MXNetError) as e:
-                    self.logger.warning(
-                        "checkpoint: optimizer state not saved (%s); "
-                        "resume will rebuild it fresh", e)
-                else:
-                    _fsync_file(spath)
-                    files["optimizer.states"] = {
-                        "sha256": sha256_file(spath),
-                        "bytes": os.path.getsize(spath),
-                    }
+        rank = snap["rank"]
+        record = {"rank": rank, "files": {}, "shards": {}}
 
-            sym = getattr(mod, "symbol", None)
-            if sym is not None:
-                sympath = os.path.join(tmp, "symbol.json")
-                sym.save(sympath)
-                _fsync_file(sympath)
-                files["symbol.json"] = {"sha256": sha256_file(sympath),
-                                        "bytes": os.path.getsize(sympath)}
+        def _write_npz(fname, plist, kill_phase=None):
+            path = os.path.join(tmp, fname)
+            with open(path, "wb") as f:
+                np.savez(f, **{p["key"]: p["data"] for p in plist})
+            if kill_phase:
+                _fi.ckpt_kill(kill_phase)
+            _fsync_file(path)
+            record["files"][fname] = {"sha256": sha256_file(path),
+                                      "bytes": os.path.getsize(path)}
+            for p in plist:
+                entry = {"file": fname, "name": p["name"],
+                         "domain": p["domain"], "index": p["index"]}
+                if "leaf" in p:
+                    entry["leaf"] = p["leaf"]
+                record["shards"][p["key"]] = entry
 
-            manifest = {
-                "format": _FORMAT,
-                "next_epoch": int(next_epoch),
-                "next_batch": int(next_batch),
-                "epoch": epoch,
-                "nbatch": nbatch,
-                "files": files,
-                "rng_key": self._rng_state(),
-                "optimizer": self._collect_optimizer_meta(),
-                "env": _env_fingerprint(),
-            }
-            # manifest last: its presence marks the directory complete
-            mpath = os.path.join(tmp, _MANIFEST)
-            with open(mpath, "w") as f:
-                json.dump(manifest, f, indent=1, sort_keys=True)
-            _fsync_file(mpath)
-            _fsync_dir(tmp)
+        if snap["pieces"]:
+            # the kill fires between the non-atomic data write and its
+            # digest/commit-record: the torn state a mid-write crash leaves
+            _write_npz(f"shard-{rank:05d}.params", snap["pieces"],
+                       kill_phase="mid-shard-write")
+        if snap["opt_pieces"]:
+            _write_npz(f"shard-{rank:05d}.opt", snap["opt_pieces"])
+        rpath = os.path.join(tmp, f"commit-{rank:05d}.json")
+        with open(rpath, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+        _fsync_file(rpath)
+        _fsync_dir(tmp)
 
-            final = os.path.join(root, name)
-            aside = None
-            if os.path.exists(final):
-                # re-save at the same cursor (rollback / replayed epoch):
-                # move the old commit ASIDE first — deleting it before the
-                # new rename lands would open a window where a crash loses
-                # the only checkpoint. Aside dirs are still loadable as a
-                # last resort (load_latest) until the swap completes.
-                aside = os.path.join(root, ".old-" + name)
-                if os.path.exists(aside):
-                    shutil.rmtree(aside)
-                os.rename(final, aside)
-            os.rename(tmp, final)
-            _fsync_dir(root)
-            if aside is not None:
-                shutil.rmtree(aside, ignore_errors=True)
-            atomic_write_bytes(os.path.join(root, _LATEST), name + "\n")
-            self._saves += 1
-            _tm.counter("checkpoint.save").inc()
-            _tm.counter("checkpoint.bytes").inc(
-                sum(f["bytes"] for f in files.values()))
-            self.logger.info("Saved checkpoint %s (resume at epoch %d "
-                             "batch %d)", final, next_epoch, next_batch)
-            self._retain(root)
-            # deterministic corruption hook for the robustness tests
-            from . import faultinject as _fi
+    def _commit(self, tmp, snap):
+        """Phase 2 on rank 0: union the per-rank commit records into the
+        manifest (written LAST), rename the directory into place, repoint
+        ``LATEST`` and prune."""
+        from . import faultinject as _fi
 
-            _fi.post_checkpoint_commit(os.path.join(final, "params"))
+        root = self.config.dir
+        name = snap["name"]
+        files = {}
+        shards = {}
+        for fn in sorted(os.listdir(tmp)):
+            if fn.startswith("commit-") and fn.endswith(".json"):
+                with open(os.path.join(tmp, fn)) as f:
+                    rec = json.load(f)
+                files.update(rec["files"])
+                shards.update(rec["shards"])
+                fpath = os.path.join(tmp, fn)
+                files[fn] = {"sha256": sha256_file(fpath),
+                             "bytes": os.path.getsize(fpath)}
+        if snap["symbol_json"] is not None:
+            sympath = os.path.join(tmp, "symbol.json")
+            with open(sympath, "w") as f:
+                f.write(snap["symbol_json"])
+            _fsync_file(sympath)
+            files["symbol.json"] = {"sha256": sha256_file(sympath),
+                                    "bytes": os.path.getsize(sympath)}
+        manifest = {
+            "format": _FORMAT,
+            "next_epoch": snap["next_epoch"],
+            "next_batch": snap["next_batch"],
+            "epoch": snap["epoch"],
+            "nbatch": snap["nbatch"],
+            "mesh": snap["mesh"],
+            "params": snap["params"],
+            "shards": shards,
+            "opt_states": snap["opt_templates"],
+            "stage_slices": snap["stage_slices"],
+            "files": files,
+            "rng_key": snap["rng"],
+            "optimizer": snap["opt_meta"],
+            "env": snap["env"],
+        }
+        _fi.ckpt_kill("pre-manifest")
+        # manifest last: its presence marks the directory complete
+        mpath = os.path.join(tmp, _MANIFEST)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        _fsync_file(mpath)
+        _fsync_dir(tmp)
+        _fi.ckpt_kill("post-manifest-pre-rename")
+
+        final = os.path.join(root, name)
+        aside = None
+        if os.path.exists(final):
+            # re-save at the same cursor (rollback / replayed epoch):
+            # move the old commit ASIDE first — deleting it before the
+            # new rename lands would open a window where a crash loses
+            # the only checkpoint. Aside dirs are still loadable as a
+            # last resort (load_latest) until the swap completes.
+            aside = os.path.join(root, ".old-" + name)
+            if os.path.exists(aside):
+                shutil.rmtree(aside)
+            os.rename(final, aside)
+        os.rename(tmp, final)
+        _fsync_dir(root)
+        if aside is not None:
+            shutil.rmtree(aside, ignore_errors=True)
+        _fi.ckpt_kill("mid-LATEST")
+        atomic_write_bytes(os.path.join(root, _LATEST), name + "\n")
+        self._saves += 1
+        _tm.counter("checkpoint.save").inc()
+        _tm.counter("checkpoint.bytes").inc(
+            sum(f["bytes"] for f in files.values()))
+        self.logger.info("Saved checkpoint %s (resume at epoch %d "
+                         "batch %d)", final, snap["next_epoch"],
+                         snap["next_batch"])
+        self._retain(root)
+        # deterministic corruption hook for the robustness tests
+        _fi.post_checkpoint_commit(
+            os.path.join(final, f"shard-{snap['rank']:05d}.params"))
         return final
 
     def _retain(self, root):
+        # stale tmp dirs (a crashed earlier attempt) are abandoned by
+        # construction — the live one was just renamed away
+        for n in os.listdir(root):
+            if n.startswith(".tmp-ckpt-"):
+                with contextlib.suppress(OSError):
+                    shutil.rmtree(os.path.join(root, n))
         keep = self.config.keep_n
         if not keep:
             return
@@ -453,16 +836,65 @@ class CheckpointManager:
     def load_latest(self):
         """The newest digest-valid checkpoint, or None.
 
-        Corrupt candidates (torn params, bad manifest) are skipped with a
+        Corrupt candidates (torn shards, bad manifest) are skipped with a
         warning — the previous valid checkpoint wins. Counted in
-        ``checkpoint.corrupt`` / ``checkpoint.fallback``."""
+        ``checkpoint.corrupt`` / ``checkpoint.fallback``. Drains any
+        in-flight async write first so the newest commit is visible."""
+        if self._async_writer is not None:
+            self._async_writer.drain()
         return load_latest(self.config.dir, logger=self.logger)
+
+    def decide_resume(self):
+        """The commit ALL ranks resume from.
+
+        Single-process (or consensus disabled): plain :meth:`load_latest`.
+        Multi-worker dist: rank 0 verifies and decides, broadcasts the
+        cursor through the kvstore, and every other rank loads exactly
+        that commit — replacing independent per-rank ``load_latest``
+        calls that could diverge (a rank scanning the directory while a
+        peer's commit is mid-rename). A non-root rank that cannot verify
+        the agreed commit raises: diverging silently is worse than
+        failing the restart attempt."""
+        from . import env as _env
+
+        kv = self.kvstore
+        if not self._dist_multi_worker() or \
+                not _env.get("MXNET_CKPT_CONSENSUS"):
+            return self.load_latest()
+        loaded = None
+        if kv.rank == 0:
+            loaded = self.load_latest()
+            if loaded is None:
+                msg = [0, 0, 0, 0]
+            else:
+                aside = int(os.path.basename(loaded.path)
+                            .startswith(".old-"))
+                msg = [1, loaded.next_epoch, loaded.next_batch, aside]
+        else:
+            msg = [0, 0, 0, 0]
+        have, e, b, aside = kv.broadcast_ints(msg)
+        _tm.counter("checkpoint.consensus").inc()
+        if not have:
+            return None
+        if kv.rank == 0:
+            return loaded
+        name = f"ckpt-e{e:05d}-b{b:08d}"
+        if aside:
+            name = ".old-" + name
+        path = os.path.join(self.config.dir, name)
+        loaded = _load_one(path)
+        _tm.counter("checkpoint.load").inc()
+        return loaded
 
     # -- restore -------------------------------------------------------
     def restore(self, loaded, module=None):
         """Push a loaded checkpoint's params + optimizer state + RNG into
         ``module`` (used for both fit-start resume and the non-finite
-        guard's rollback escalation)."""
+        guard's rollback escalation). The loader hands back full logical
+        host arrays; ``set_params`` re-places them under the CURRENT
+        mesh's shardings — this is the elastic half of cross-topology
+        resume (pipeline packed rows rebuild from the child executors on
+        the next run())."""
         mod = module or self.module
         mod.set_params(loaded.arg_params, loaded.aux_params,
                        allow_missing=False, force_init=True)
@@ -471,11 +903,15 @@ class CheckpointManager:
 
     def restore_optimizer(self, loaded, module=None):
         """Restore optimizer state/update counts and the RNG key (the part
-        of resume that must run AFTER init_optimizer)."""
+        of resume that must run AFTER init_optimizer). v2 checkpoints
+        restore per-parameter by name; v1 restores the opaque updater
+        blob."""
         mod = module or self.module
         if not getattr(mod, "optimizer_initialized", False):
             return
-        if loaded.opt_states_path is not None and \
+        if loaded.opt_states_by_name is not None:
+            self._restore_opt_by_name(loaded, mod)
+        elif loaded.opt_states_path is not None and \
                 hasattr(mod, "load_optimizer_states"):
             try:
                 mod.load_optimizer_states(loaded.opt_states_path)
@@ -484,15 +920,26 @@ class CheckpointManager:
                     "checkpoint: optimizer state not restored (%s); "
                     "momentum/variance restart fresh", e)
         meta = loaded.manifest.get("optimizer")
-        opt = getattr(mod, "_optimizer", None)
-        if meta and opt is not None:
-            opt.num_update = int(meta.get("num_update", 0))
-            opt.begin_num_update = int(meta.get("begin_num_update", 0))
-            counts = meta.get("index_update_count") or {}
-            opt._index_update_count = {
-                (int(k) if k.lstrip("-").isdigit() else k): int(v)
-                for k, v in counts.items()
-            }
+        if meta:
+            for m in _leaf_modules(mod):
+                opt = getattr(m, "_optimizer", None)
+                if opt is None:
+                    continue
+                opt.num_update = int(meta.get("num_update", 0))
+                opt.begin_num_update = int(meta.get("begin_num_update", 0))
+                if "update_count" in meta:  # v2: by name
+                    names = _module_param_names(m)
+                    by_name = meta["update_count"] or {}
+                    opt._index_update_count = {
+                        i: int(by_name[n])
+                        for i, n in enumerate(names) if n in by_name
+                    }
+                else:  # v1: by updater index
+                    counts = meta.get("index_update_count") or {}
+                    opt._index_update_count = {
+                        (int(k) if k.lstrip("-").isdigit() else k): int(v)
+                        for k, v in counts.items()
+                    }
         rng = loaded.manifest.get("rng_key")
         if rng is not None:
             try:
@@ -504,25 +951,137 @@ class CheckpointManager:
                     "checkpoint: RNG state not restored; stochastic ops "
                     "resume from a fresh key")
 
+    def _restore_opt_by_name(self, loaded, mod):
+        """Rebuild each leaf module's updater states from the by-name
+        map; a parameter the checkpoint doesn't know starts fresh (the
+        updater lazily creates its state on first update)."""
+        from .optimizer import _states_from_numpy
+
+        by_name = loaded.opt_states_by_name
+        matched = 0
+        for m in _leaf_modules(mod):
+            upd = _module_updater(m)
+            if upd is None:
+                continue
+            names = _module_param_names(m)
+            states = {}
+            for i, n in enumerate(names):
+                if n in by_name:
+                    states[i] = _states_from_numpy(
+                        _template_to_state(by_name[n]))
+                    matched += 1
+            upd.states = states
+        if matched < len(by_name):
+            self.logger.warning(
+                "checkpoint: %d optimizer state entries had no matching "
+                "parameter in the current module; dropped",
+                len(by_name) - matched)
+
+
+def _template_to_state(v):
+    """The by-name pytree stores tuples as lists (JSON); updater states
+    use tuples."""
+    if isinstance(v, list):
+        return tuple(_template_to_state(x) for x in v)
+    return v
+
+
+class _AsyncCheckpointWriter:
+    """Runs :meth:`CheckpointManager._write_local` off-thread.
+
+    Lock discipline (enforced by graftlint's lock-discipline checker):
+    ``_writer_lock`` guards ONLY the hand-off slot (``_pending``,
+    ``_error``, ``_stop``) — never file I/O, never device reads. The
+    snapshot handed over is pure host numpy + JSON, so the writer thread
+    is jax-free. At most one write is in flight; a second ``submit``
+    while one is pending blocks (``checkpoint.async_backpressure``) so
+    commits stay ordered and LATEST/retention stay correct."""
+
+    def __init__(self, manager):
+        self._manager = manager
+        self._writer_lock = threading.Condition()
+        self._pending = None
+        self._error = None
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def submit(self, snap):
+        err = None
+        with self._writer_lock:
+            if self._pending is not None:
+                _tm.counter("checkpoint.async_backpressure").inc()
+                while self._pending is not None:
+                    self._writer_lock.wait()
+            err, self._error = self._error, None
+            self._pending = snap
+            self._writer_lock.notify_all()
+        if err is not None:
+            self._manager.logger.warning(
+                "checkpoint: previous async write failed (%s); the "
+                "commit was skipped", err)
+
+    def drain(self):
+        """Block until no write is in flight; surface any write error."""
+        err = None
+        with self._writer_lock:
+            while self._pending is not None:
+                self._writer_lock.wait()
+            err, self._error = self._error, None
+        if err is not None:
+            self._manager.logger.warning(
+                "checkpoint: async write failed (%s); the commit was "
+                "skipped", err)
+
+    def close(self):
+        self.drain()
+        with self._writer_lock:
+            self._stop = True
+            self._writer_lock.notify_all()
+        self._thread.join(timeout=60)
+
+    def _run(self):
+        while True:
+            with self._writer_lock:
+                while self._pending is None and not self._stop:
+                    self._writer_lock.wait()
+                if self._pending is None and self._stop:
+                    return
+                snap = self._pending
+            # file I/O runs with the lock RELEASED; _pending stays set as
+            # the in-flight marker until the commit lands
+            err = None
+            try:
+                with _tm.span("checkpoint.write_async"):
+                    self._manager._write_local(snap)
+            except BaseException as e:  # the writer thread must survive
+                err = e
+            with self._writer_lock:
+                self._pending = None
+                if err is not None:
+                    self._error = err
+                self._writer_lock.notify_all()
+
+
+# --- loading ----------------------------------------------------------------
 
 def load_latest(directory, logger=None):
     """Module-level loader (what ``CheckpointManager.load_latest`` and the
     tests use): newest digest-valid checkpoint under ``directory`` or
-    None, falling back past corrupt entries."""
+    None, falling back past corrupt entries.
+
+    Candidates are ordered newest-first by NAME (the cursor-encoding name
+    is zero-padded, so lexicographic = chronological) rather than by the
+    ``LATEST`` pointer: a crash between rename and LATEST update leaves
+    the pointer stale, and the newest fully-committed checkpoint must
+    still win."""
     log = logger or logging.getLogger("mxnet_tpu.checkpoint")
     if not os.path.isdir(directory):
         return None
-    candidates = []
-    latest = None
-    with contextlib.suppress(OSError):
-        with open(os.path.join(directory, _LATEST)) as f:
-            latest = f.read().strip() or None
     entries = os.listdir(directory)
-    names = sorted((n for n in entries if n.startswith("ckpt-")),
-                   reverse=True)
-    if latest and latest in names:
-        candidates.append(latest)
-    candidates.extend(n for n in names if n != latest)
+    candidates = sorted((n for n in entries if n.startswith("ckpt-")),
+                        reverse=True)
     # aside dirs (a crash mid same-cursor re-commit): last-resort fallback
     candidates.extend(sorted(
         (n for n in entries if n.startswith(".old-ckpt-")), reverse=True))
@@ -550,41 +1109,249 @@ def load_latest(directory, logger=None):
     return None
 
 
+def read_manifest(path):
+    """Parse and structurally validate ``path``'s manifest (no digest
+    walk). Raises :class:`CheckpointCorrupt`."""
+    mpath = os.path.join(path, _MANIFEST)
+    if not os.path.exists(mpath):
+        raise CheckpointCorrupt("missing manifest (incomplete commit)")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CheckpointCorrupt(f"unreadable manifest: {e}") from e
+    if manifest.get("format") not in (_FORMAT_V1, _FORMAT):
+        raise CheckpointCorrupt(
+            f"unknown manifest format {manifest.get('format')!r}")
+    for key in ("next_epoch", "next_batch", "files"):
+        if key not in manifest:
+            raise CheckpointCorrupt(f"manifest missing {key!r}")
+    return manifest
+
+
+def verify_dir(path):
+    """Full offline verification of one commit directory (jax-free):
+    manifest structure, per-file size + sha256, and — for v2 — that the
+    recorded shard pieces geometrically cover every logical parameter.
+    Returns the manifest; raises :class:`CheckpointCorrupt`."""
+    manifest = read_manifest(path)
+    for fname, meta in manifest["files"].items():
+        fpath = os.path.join(path, fname)
+        if not os.path.exists(fpath):
+            raise CheckpointCorrupt(f"missing file {fname}")
+        if os.path.getsize(fpath) != meta["bytes"]:
+            raise CheckpointCorrupt(
+                f"{fname}: size {os.path.getsize(fpath)} != manifest "
+                f"{meta['bytes']} (truncated write?)")
+        if sha256_file(fpath) != meta["sha256"]:
+            raise CheckpointCorrupt(f"{fname}: sha256 mismatch")
+    if manifest["format"] == _FORMAT_V1:
+        if "params" not in manifest["files"]:
+            raise CheckpointCorrupt("manifest lists no params file")
+        return manifest
+    _verify_coverage(manifest)
+    return manifest
+
+
+def _piece_size(index):
+    n = 1
+    for start, stop in index:
+        n *= max(0, stop - start)
+    return n
+
+
+def _verify_coverage(manifest):
+    """Every logical parameter must be fully covered by its recorded
+    pieces (pure geometry from the manifest — no array reads). Pieces
+    come from the replica-0 filter over a mesh sharding, so they are
+    disjoint by construction; element-count accounting detects gaps."""
+    covered = {}
+    for key, sh in manifest.get("shards", {}).items():
+        if sh.get("domain") != "param":
+            continue
+        covered[sh["name"]] = covered.get(sh["name"], 0) + \
+            _piece_size(sh["index"])
+    for name, meta in manifest.get("params", {}).items():
+        total = 1
+        for s in meta["shape"]:
+            total *= int(s)
+        if covered.get(name, 0) != total:
+            raise CheckpointCorrupt(
+                f"param {name}: shard pieces cover {covered.get(name, 0)} "
+                f"of {total} elements (incomplete shard set)")
+
+
 def _load_one(path):
+    with _tm.span("checkpoint.load_verify"):
+        manifest = verify_dir(path)
+        if manifest["format"] == _FORMAT_V1:
+            return _load_v1(path, manifest)
+        return _load_v2(path, manifest)
+
+
+def _load_v1(path, manifest):
+    """The replicated single-file path format v1 directories keep using."""
     from .model import _split_param_dict
     from .ndarray import load as nd_load
 
-    with _tm.span("checkpoint.load_verify"):
-        mpath = os.path.join(path, _MANIFEST)
-        if not os.path.exists(mpath):
-            raise CheckpointCorrupt("missing manifest (incomplete commit)")
+    save_dict = nd_load(os.path.join(path, "params"))
+    arg_params, aux_params = _split_param_dict(
+        save_dict, os.path.join(path, "params"))
+    spath = os.path.join(path, "optimizer.states")
+    opt_states = spath if "optimizer.states" in manifest["files"] else None
+    return LoadedCheckpoint(path, manifest, arg_params, aux_params,
+                            opt_states)
+
+
+def _assemble(shape, dtype, pieces):
+    """One logical array from ``[(index, numpy), ...]`` shard pieces."""
+    import numpy as np
+
+    shape = tuple(int(s) for s in shape)
+    total = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if len(pieces) == 1 and _piece_size(pieces[0][0]) == total:
+        return np.asarray(pieces[0][1], dtype=dtype).reshape(shape)
+    out = np.zeros(shape, dtype=dtype)
+    for index, data in pieces:
+        sel = tuple(slice(start, stop) for start, stop in index)
+        out[sel] = np.asarray(data, dtype=dtype).reshape(
+            tuple(stop - start for start, stop in index))
+    return out
+
+
+def _load_v2(path, manifest):
+    """Elastic reassembly: read every shard container, stitch each
+    logical parameter (and optimizer state leaf) back together from its
+    recorded global-index pieces, and return full host arrays — the
+    caller re-places them under whatever mesh is current."""
+    import numpy as np
+
+    from .ndarray import array as nd_array
+
+    containers = {}
+
+    def piece_data(fname, key):
+        if fname not in containers:
+            containers[fname] = np.load(os.path.join(path, fname))
         try:
-            with open(mpath) as f:
-                manifest = json.load(f)
-        except (json.JSONDecodeError, UnicodeDecodeError) as e:
-            raise CheckpointCorrupt(f"unreadable manifest: {e}") from e
-        if manifest.get("format") != _FORMAT:
+            return containers[fname][key]
+        except KeyError:
             raise CheckpointCorrupt(
-                f"unknown manifest format {manifest.get('format')!r}")
-        for key in ("next_epoch", "next_batch", "files"):
-            if key not in manifest:
-                raise CheckpointCorrupt(f"manifest missing {key!r}")
-        for fname, meta in manifest["files"].items():
-            fpath = os.path.join(path, fname)
-            if not os.path.exists(fpath):
-                raise CheckpointCorrupt(f"missing file {fname}")
-            if os.path.getsize(fpath) != meta["bytes"]:
-                raise CheckpointCorrupt(
-                    f"{fname}: size {os.path.getsize(fpath)} != manifest "
-                    f"{meta['bytes']} (truncated write?)")
-            if sha256_file(fpath) != meta["sha256"]:
-                raise CheckpointCorrupt(f"{fname}: sha256 mismatch")
-        if "params" not in manifest["files"]:
-            raise CheckpointCorrupt("manifest lists no params file")
-        save_dict = nd_load(os.path.join(path, "params"))
-        arg_params, aux_params = _split_param_dict(
-            save_dict, os.path.join(path, "params"))
-        spath = os.path.join(path, "optimizer.states")
-        opt_states = spath if "optimizer.states" in manifest["files"] else None
-        return LoadedCheckpoint(path, manifest, arg_params, aux_params,
-                                opt_states)
+                f"{fname}: shard container missing key {key!r}")
+
+    by_param = {}
+    by_leaf = {}
+    for key, sh in manifest.get("shards", {}).items():
+        piece = (sh["index"], piece_data(sh["file"], key))
+        if sh["domain"] == "param":
+            by_param.setdefault(sh["name"], []).append(piece)
+        else:
+            by_leaf.setdefault((sh["name"], sh["leaf"]), []).append(piece)
+
+    arg_params, aux_params = {}, {}
+    for name, meta in manifest.get("params", {}).items():
+        pieces = by_param.get(name)
+        if not pieces:
+            raise CheckpointCorrupt(f"param {name}: no shard pieces")
+        arr = _assemble(meta["shape"], np.dtype(meta["dtype"]), pieces)
+        target = arg_params if meta["kind"] == "arg" else aux_params
+        target[name] = nd_array(arr, dtype=arr.dtype)
+
+    opt_by_name = None
+    if manifest.get("opt_states") is not None:
+        opt_by_name = {}
+        for name, template in manifest["opt_states"].items():
+            opt_by_name[name] = _fill_template(
+                template, name, by_leaf)
+    return LoadedCheckpoint(path, manifest, arg_params, aux_params,
+                            None, opt_states_by_name=opt_by_name)
+
+
+def _fill_template(template, name, by_leaf):
+    """Rehydrate one optimizer state pytree: leaf nodes pull their
+    reassembled arrays, scalars pass through, lists stay lists (turned
+    into tuples at restore)."""
+    import numpy as np
+
+    if template is None:
+        return None
+    if isinstance(template, list):
+        return [_fill_template(t, name, by_leaf) for t in template]
+    if "value" in template:
+        return template["value"]
+    pieces = by_leaf.get((name, template["leaf"]))
+    if not pieces:
+        raise CheckpointCorrupt(
+            f"optimizer state {name}#{template['leaf']}: no shard pieces")
+    return _assemble(template["shape"], np.dtype(template["dtype"]),
+                     pieces)
+
+
+# --- offline consolidation (tools/ckpt.py reshard) ---------------------------
+
+def consolidate(loaded, out_dir, mesh_spec=None):
+    """Rewrite a loaded checkpoint as a single-shard v2 commit under
+    ``out_dir``, stamped for ``mesh_spec`` — offline resharding without a
+    training process. The elastic loader accepts any source layout, so
+    consolidation to full pieces is always a valid re-layout."""
+    import numpy as np
+
+    pieces, opt_pieces = [], []
+    params_meta = {}
+    for kind, d in (("arg", loaded.arg_params), ("aux", loaded.aux_params)):
+        for name in sorted(d):
+            arr = d[name]
+            arr = arr.asnumpy() if hasattr(arr, "asnumpy") else \
+                np.asarray(arr)
+            params_meta[name] = {"kind": kind,
+                                 "shape": [int(s) for s in arr.shape],
+                                 "dtype": str(arr.dtype), "spec": None}
+            pieces.append({"key": f"{kind}:{name}@0#0", "name": name,
+                           "domain": "param",
+                           "index": _full_index(arr.shape), "data": arr})
+    templates = None
+    if loaded.opt_states_by_name is not None:
+        templates = {}
+        for name, state in loaded.opt_states_by_name.items():
+            counter = [0]
+
+            def conv(v):
+                if v is None:
+                    return None
+                if isinstance(v, (list, tuple)):
+                    return [conv(x) for x in v]
+                if not isinstance(v, np.ndarray):
+                    return {"value": v}
+                i = counter[0]
+                counter[0] += 1
+                opt_pieces.append({
+                    "key": f"opt:{name}#{i}@0#0", "name": name,
+                    "leaf": i, "domain": "opt",
+                    "index": _full_index(v.shape), "data": v})
+                return {"leaf": i, "shape": [int(s) for s in v.shape],
+                        "dtype": str(v.dtype)}
+
+            templates[name] = conv(state)
+    m = loaded.manifest
+    snap = {
+        "name": os.path.basename(loaded.path.rstrip(os.sep))
+        .replace(".old-", ""),
+        "rank": 0,
+        "next_epoch": int(m["next_epoch"]),
+        "next_batch": int(m["next_batch"]),
+        "epoch": m.get("epoch"), "nbatch": m.get("nbatch"),
+        "params": params_meta,
+        "pieces": pieces,
+        "opt_templates": templates,
+        "opt_pieces": opt_pieces,
+        "opt_meta": m.get("optimizer"),
+        "mesh": {"spec": mesh_spec, "devices": None,
+                 "platform": "offline", "processes": 1}
+        if mesh_spec else m.get("mesh"),
+        "stage_slices": None,
+        "symbol_json": None,
+        "rng": m.get("rng_key"),
+        "env": m.get("env"),
+    }
+    mgr = CheckpointManager(CheckpointConfig(out_dir, keep_n=0))
+    return mgr._write_local(snap)

@@ -179,6 +179,18 @@ _declare("MXNET_CHECKPOINT_BATCH_PERIOD", int, 0,
          "Additionally checkpoint every N batches mid-epoch (0 = epoch "
          "boundaries only). Mid-epoch checkpoints record the batch cursor "
          "so resume skips already-trained batches.")
+_declare("MXNET_CKPT_ASYNC", _parse_bool, False,
+         "Run checkpoint file writes on a dedicated writer thread so the "
+         "training pause covers only the device-to-host snapshot "
+         "(checkpoint.snapshot span); the commit itself overlaps training "
+         "(checkpoint.write_async span). Forced off under a multi-worker "
+         "dist kvstore, whose two-phase commit is barrier-fenced.")
+_declare("MXNET_CKPT_CONSENSUS", _parse_bool, True,
+         "Under a multi-worker dist kvstore, resume from the commit rank 0 "
+         "verified and broadcast through the kvstore instead of each rank "
+         "scanning the checkpoint directory independently (which can "
+         "diverge when a scan races a mid-commit rename). Disable only "
+         "for debugging.")
 _declare("MXNET_IO_RETRY", int, 0,
          "When > 0, Module.fit wraps the training iterator in "
          "io.RetryingIter: transient data-source failures (IOError/OSError/"
@@ -211,6 +223,12 @@ _declare("MXNET_FI_CORRUPT_CKPT", str, "",
          "Fault injection: 'truncate' or 'garbage' — damage each "
          "checkpoint's params file right after commit, forcing digest "
          "verification to fall back to the previous valid checkpoint.")
+_declare("MXNET_FI_CKPT_KILL_PHASE", str, "",
+         "Fault injection: os._exit (kill -9) at a named phase inside the "
+         "checkpoint commit — 'mid-shard-write', 'pre-manifest', "
+         "'post-manifest-pre-rename' or 'mid-LATEST' — the torn states a "
+         "mid-save SIGKILL can leave. Gated by MXNET_FI_ATTEMPT/"
+         "MXNET_FI_RANK like every MXNET_FI_* injection.")
 _declare("MXNET_NUM_RESTARTS", int, 0,
          "Launcher attempt ordinal, exported by tools/launch.py "
          "--max-restarts relaunches (0 = first life). Read by dead-node "

@@ -20,6 +20,18 @@ fault without patching framework code:
                                 params file of every checkpoint right after
                                 it commits — exercises digest verification
                                 and previous-checkpoint fallback.
+``MXNET_FI_CKPT_KILL_PHASE``    ``os._exit`` at a named phase INSIDE the
+                                checkpoint commit sequence:
+                                ``mid-shard-write`` (shard data written,
+                                digest/commit record not),
+                                ``pre-manifest`` (rank files durable,
+                                manifest absent),
+                                ``post-manifest-pre-rename`` (complete tmp
+                                dir, never renamed in), and ``mid-LATEST``
+                                (commit renamed in, LATEST still stale) —
+                                the four torn states a mid-save SIGKILL
+                                can leave. Exercises two-phase commit +
+                                newest-valid-wins recovery.
 ``MXNET_FI_ATTEMPT``            which launcher attempt the injections apply
                                 to (compared against ``MXNET_NUM_RESTARTS``;
                                 default 0 = first life only, so a restarted
@@ -104,7 +116,8 @@ def active():
     """True when any fault is configured for THIS launcher attempt+rank."""
     if not any(_env.raw(k) for k in (
             "MXNET_FI_CRASH_AT_BATCH", "MXNET_FI_NAN_BATCHES",
-            "MXNET_FI_ITER_RAISE_BATCHES", "MXNET_FI_CORRUPT_CKPT")):
+            "MXNET_FI_ITER_RAISE_BATCHES", "MXNET_FI_CORRUPT_CKPT",
+            "MXNET_FI_CKPT_KILL_PHASE")):
         return False
     return _attempt_matches() and _rank_matches()
 
@@ -216,6 +229,21 @@ def on_serving_reload(replica_id):
         raise MXNetError(
             f"faultinject: injected reload corruption on replica "
             f"{replica_id}")
+
+
+def ckpt_kill(phase):
+    """Called by CheckpointManager at each named point of the commit
+    sequence: ``os._exit`` (a kill -9, mid-save) when
+    ``MXNET_FI_CKPT_KILL_PHASE`` names this phase for this attempt+rank.
+    The chaos tests assert that whatever torn state each phase leaves,
+    the newest previously-valid commit still loads."""
+    want = _env.get("MXNET_FI_CKPT_KILL_PHASE")
+    if not want or want != phase:
+        return
+    if not _attempt_matches() or not _rank_matches():
+        return
+    print(f"faultinject: CKPT-KILL at phase {phase}", flush=True)
+    os._exit(_env.get("MXNET_FI_EXIT_CODE"))
 
 
 def post_checkpoint_commit(params_path):

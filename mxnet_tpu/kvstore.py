@@ -271,6 +271,13 @@ class KVStore:
         with open(fname, "rb") as fin:
             self._updater.set_states(fin.read())
 
+    def broadcast_ints(self, values):
+        """Rank 0's small integer vector, agreed on every rank — the
+        control-plane primitive checkpoint resume consensus rides
+        (CheckpointManager.decide_resume). Single-process stores are
+        trivially in agreement."""
+        return [int(v) for v in values]
+
     # --- cluster plane -------------------------------------------------
     def barrier(self):
         pass
@@ -421,6 +428,25 @@ class DistKVStore(KVStore):
                 self._updater(_updater_key(k), merged, self._store[k])
             else:
                 self._store[k] = merged
+
+    def broadcast_ints(self, values):
+        """Rank 0's integer vector on every rank: rank 0 contributes the
+        values, everyone else zeros, one sum all-reduce — same
+        rank-0-wins pattern as :meth:`init`, and doubles as a barrier
+        (every rank leaves with the decision, or no rank does)."""
+        import numpy as np
+
+        vals = [int(v) for v in values]
+        if self.num_workers == 1:
+            return vals
+        from .ndarray import array as nd_array
+
+        contrib = np.asarray(vals if self.rank == 0 else [0] * len(vals),
+                             dtype=np.int64)
+        with _CollectiveWatchdog("broadcast_ints", self.rank,
+                                 self.num_workers, _kv_timeout()):
+            out = np.asarray(self._allreduce(nd_array(contrib)))
+        return [int(v) for v in out]
 
     def barrier(self):
         # an all-reduce of a scalar synchronises all hosts; must BLOCK —

@@ -135,6 +135,22 @@ class GraftMesh:
             getattr(self.mesh.devices.flat[0], "platform", ""),
         )
 
+    def manifest_entry(self):
+        """The mesh identity a checkpoint manifest records (format v2):
+        :meth:`cache_token` flattened to JSON-able fields plus the process
+        count. Restore never REQUIRES a matching entry — the elastic
+        loader re-places parameters under whatever mesh is current — but
+        tools/ckpt.py surfaces it and mismatch diagnostics cite it."""
+        import jax
+
+        spec, devices, platform = self.cache_token()
+        return {
+            "spec": spec,
+            "devices": list(devices),
+            "platform": platform,
+            "processes": int(jax.process_count()),
+        }
+
     # -- construction -----------------------------------------------------
     @classmethod
     def from_axes(cls, axis_sizes, devices=None, backend=None):

@@ -296,6 +296,37 @@ class PipelineEngine:
                                   else info.param_entries)
                               for info in self.infos]}
 
+    def stage_slices(self):
+        """Packed-row placement per parameter, for checkpoint manifests:
+        ``{name: {stage, aux, dtype, offset, size, shape, lmax}}`` (None
+        when this pipeline doesn't pack, i.e. homogeneous mode).
+
+        Purely descriptive — the elastic loader restores into the child
+        executors and rows repack from them on the next run(), so resume
+        onto a DIFFERENT pipeline layout never reads these offsets. They
+        let tools/ckpt.py display/audit the packed geometry a commit was
+        trained under, and pin the round-trip contract in tests."""
+        if getattr(self, "_param_layout", None) is None:
+            return None
+        out = {}
+        for is_aux, layout in ((False, self._param_layout),
+                               (True, self._aux_layout)):
+            for i, info in enumerate(self.infos):
+                entries = info.aux_entries if is_aux else info.param_entries
+                for dt, (_used, sl) in layout["per_stage"][i].items():
+                    for j, off, size, shape in sl:
+                        name = entries[j][1]
+                        out[name] = {
+                            "stage": i,
+                            "aux": is_aux,
+                            "dtype": dt,
+                            "offset": int(off),
+                            "size": int(size),
+                            "shape": [int(s) for s in shape],
+                            "lmax": int(layout["lmax"][dt]),
+                        }
+        return out
+
     def _row_spec_entry(self):
         """The PartitionSpec entry sharding a packed row's flat dim over
         the stage rank set's dp×tp sub-mesh (None on a pure-pp mesh)."""

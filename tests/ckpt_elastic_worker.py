@@ -1,0 +1,85 @@
+"""Worker for the composed-mesh kill-resume test
+(tests/test_composed_parallelism.py).
+
+Same deterministic MLP and convergence pin as ckpt_resume_worker.py, but
+trained as a 2-stage pipeline under a composed GraftMesh (WORKER_MESH,
+default dp2,pp2) with env-driven v2 sharded checkpointing. The test's
+first launch sets MXNET_FI_CRASH_AT_BATCH so faultinject hard-kills the
+process mid-epoch; the second sets MXNET_NUM_RESTARTS=1 so the injection
+is disarmed and fit must auto-resume from the last committed elastic
+checkpoint.
+
+Prints the same machine-checkable lines as the single-host worker:
+  RESUME epoch=<E> batch=<B> num_update=<N>
+  TRAIN-DONE acc=<float> final_update=<N>
+"""
+
+import logging
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import numpy as np
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, stream=sys.stdout)
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.parallel.mesh import GraftMesh
+
+    rng = np.random.RandomState(42)
+    X = rng.randn(64, 10).astype(np.float32)
+    W = rng.randn(10, 4).astype(np.float32)
+    Y = X.dot(W).argmax(1).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    s0 = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=16, name="fc1"),
+        act_type="relu")
+    data = mx.sym.Variable("data")
+    s1 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc2"),
+        name="softmax")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(s0, data_names=("data",), label_names=None))
+    seq.add(mx.mod.Module(s1, data_names=("data",),
+                          label_names=("softmax_label",)),
+            take_labels=True, auto_wiring=True)
+    gm = GraftMesh.from_spec(os.environ.get("WORKER_MESH", "dp2,pp2"))
+    with parallel.with_mesh(gm):
+        seq.bind(data_shapes=[("data", (8, 10))],
+                 label_shapes=[("softmax_label", (8,))])
+
+    it = mx.io.NDArrayIter(X, Y, batch_size=8)  # 8 batches/epoch
+
+    ckpt_dir = os.environ["MXNET_CHECKPOINT_DIR"]
+    loaded = mx.checkpoint.load_latest(ckpt_dir)
+    if loaded is None:
+        print("RESUME epoch=-1 batch=-1 num_update=0", flush=True)
+    else:
+        meta = loaded.manifest.get("optimizer") or {}
+        print(f"RESUME epoch={loaded.next_epoch} batch={loaded.next_batch} "
+              f"num_update={meta.get('num_update', 0)}", flush=True)
+
+    mx.random.seed(7)
+    with parallel.with_mesh(gm):
+        seq.fit(
+            it, num_epoch=int(os.environ.get("WORKER_NUM_EPOCH", "6")),
+            initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+        )
+        metric = mx.metric.Accuracy()
+        acc = seq.score(it, metric)[0][1]
+    final_update = max(m._optimizer.num_update for m in seq._children())
+    print(f"TRAIN-DONE acc={acc:.3f} final_update={final_update}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

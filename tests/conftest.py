@@ -31,19 +31,77 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
+_DIST_PROBE = None  # None = not probed yet; True/False = cached verdict
+
+
+def _dist_collectives_supported():
+    """Probe (once per session): can this backend execute a CROSS-PROCESS
+    collective? XLA:CPU cannot ("Multiprocess computations aren't
+    implemented on the CPU backend") — the 8-device virtual mesh above is
+    single-process only. Spawn a real 2-rank dist_sync allreduce through
+    tools/launch.py (the exact op the dist tests exercise) and see if it
+    completes; TPU/GPU pods pass, CPU-only hosts skip."""
+    global _DIST_PROBE
+    if _DIST_PROBE is not None:
+        return _DIST_PROBE
+    import socket
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    worker = (
+        "import os; os.environ['JAX_PLATFORMS'] = "
+        "os.environ.get('JAX_PLATFORMS', 'cpu');"
+        "import mxnet_tpu as mx;"
+        "kv = mx.kv.create('dist_sync');"
+        "a = mx.nd.ones((2,)); kv.init(0, a); kv.push(0, a);"
+        "out = mx.nd.zeros((2,)); kv.pull(0, out=out);"
+        "print('DIST-PROBE OK', float(out.asnumpy().sum()), flush=True)"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # ranks get their own un-virtualized jax
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, os.path.join(root, "tools", "launch.py"),
+           "-n", "2", "--launcher", "local", "--port", str(port),
+           sys.executable, "-c", worker]
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=120)
+        _DIST_PROBE = (proc.returncode == 0
+                       and proc.stdout.count("DIST-PROBE OK") >= 2)
+    except (subprocess.TimeoutExpired, OSError):
+        _DIST_PROBE = False
+    return _DIST_PROBE
+
+
 def pytest_collection_modifyitems(config, items):
-    """Skip @pytest.mark.aot_serialization tests on backends that cannot
-    serialize compiled executables (probed once, mxnet_tpu.aot)."""
+    """Skip capability-gated tests on backends missing the capability:
+    @pytest.mark.aot_serialization when compiled executables cannot
+    serialize (probed via mxnet_tpu.aot), @pytest.mark.dist_multiprocess
+    when cross-process collectives cannot execute (probed via a 2-rank
+    launch)."""
     import pytest
 
     marked = [item for item in items
               if "aot_serialization" in item.keywords]
-    if not marked:
-        return
-    from mxnet_tpu import aot
+    if marked:
+        from mxnet_tpu import aot
 
-    if not aot.supports_serialization():
+        if not aot.supports_serialization():
+            skip = pytest.mark.skip(
+                reason="backend cannot serialize compiled executables")
+            for item in marked:
+                item.add_marker(skip)
+
+    dist_marked = [item for item in items
+                   if "dist_multiprocess" in item.keywords]
+    if dist_marked and not _dist_collectives_supported():
         skip = pytest.mark.skip(
-            reason="backend cannot serialize compiled executables")
-        for item in marked:
+            reason="backend cannot execute multiprocess collectives "
+                   "(XLA:CPU); probed via a 2-rank dist_sync allreduce")
+        for item in dist_marked:
             item.add_marker(skip)

@@ -27,3 +27,15 @@ class Pool:
         with self.run_lock:
             out = model.forward(batch)   # BAD: device call under run lock
             fut.set_result(out)          # BAD: client callback under lock
+
+
+class AsyncWriter:
+    def __init__(self):
+        self._writer_lock = threading.Condition()
+        self._pending = None
+
+    def submit(self, snap, path):
+        with self._writer_lock:
+            with open(path, "wb") as f:     # BAD: I/O under hand-off lock
+                f.write(snap)               # BAD: I/O under hand-off lock
+            self._pending = snap

@@ -89,8 +89,10 @@ def test_accnn_compresses_and_finetunes():
                      allow_missing=False)
     # SVD init alone keeps the model usable...
     svd_acc = _accuracy(mod2, x, y)
-    # ...and the reference recipe (brief fine-tune) recovers accuracy
-    _fit(mod2, x, y, epochs=3)
+    # ...and the reference recipe (brief fine-tune at a REDUCED lr — the
+    # training lr overshoots on the factored net and can walk a perfect
+    # model down to ~0.8) recovers accuracy
+    _fit(mod2, x, y, epochs=3, lr=0.001)
     tuned_acc = _accuracy(mod2, x, y)
     assert tuned_acc > max(0.9, base_acc - 0.05), (base_acc, svd_acc,
                                                    tuned_acc)

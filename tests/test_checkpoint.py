@@ -115,7 +115,18 @@ def test_manifest_contents_and_digests(tmp_path):
         p = os.path.join(d, latest, fname)
         assert os.path.getsize(p) == meta["bytes"]
         assert ckpt.sha256_file(p) == meta["sha256"]
-    assert "params" in m["files"] and "optimizer.states" in m["files"]
+    # format v2: per-process shard containers instead of one replicated
+    # params blob; optimizer state rides its own shard file per rank
+    assert m["format"] == 2
+    assert "shard-00000.params" in m["files"]
+    assert "shard-00000.opt" in m["files"]
+    assert "commit-00000.json" in m["files"]
+    # every logical parameter is described and fully covered by shards
+    assert "fc1_weight" in m["params"]
+    assert m["params"]["fc1_weight"]["kind"] == "arg"
+    ckpt._verify_coverage(m)
+    # per-parameter optimizer state templates (restore is by name)
+    assert "fc1_weight" in m["opt_states"]
     assert m["rng_key"] is not None and m["env"]
 
 
@@ -134,7 +145,8 @@ def test_truncated_checkpoint_falls_back(tmp_path, caplog):
     _fit_module(tmp_path, num_epoch=3,
                 checkpoint=mx.CheckpointConfig(d, period=1, keep_n=3))
     names = sorted(n for n in os.listdir(d) if n.startswith("ckpt-"))
-    fi.corrupt_file(os.path.join(d, names[-1], "params"), "truncate")
+    fi.corrupt_file(os.path.join(d, names[-1], "shard-00000.params"),
+                    "truncate")
     c0 = tm.counter("checkpoint.corrupt").value
     with caplog.at_level("WARNING"):
         loaded = ckpt.load_latest(d)
@@ -143,12 +155,14 @@ def test_truncated_checkpoint_falls_back(tmp_path, caplog):
     assert any("corrupt" in r.message for r in caplog.records)
 
     # garbage (bit-flip) corruption is also caught by the sha256
-    fi.corrupt_file(os.path.join(d, names[-2], "params"), "garbage")
+    fi.corrupt_file(os.path.join(d, names[-2], "shard-00000.params"),
+                    "garbage")
     loaded = ckpt.load_latest(d)
     assert loaded is not None and loaded.path.endswith(names[-3])
 
     # every checkpoint corrupt -> None, not a crash
-    fi.corrupt_file(os.path.join(d, names[-3], "params"), "truncate")
+    fi.corrupt_file(os.path.join(d, names[-3], "shard-00000.params"),
+                    "truncate")
     assert ckpt.load_latest(d) is None
 
 

@@ -493,3 +493,58 @@ def test_microbatch_not_divisible_by_dp_raises():
             # use a batch that breaks: 8 microbatches of 1 row each
             seq.bind(data_shapes=[("data", (8, DIM))],
                      label_shapes=[("softmax_label", (8,))])
+
+
+# --------------------------------------------------------------------------
+# composed-mesh kill-and-resume (elastic v2 checkpoints under dp×pp)
+# --------------------------------------------------------------------------
+
+def _run_elastic_worker(env, timeout=240):
+    import subprocess
+    import sys
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    e = dict(os.environ)
+    clean = [p for p in e.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p]
+    e["PYTHONPATH"] = os.pathsep.join([root] + clean)
+    e["JAX_PLATFORMS"] = "cpu"
+    e.pop("XLA_FLAGS", None)  # worker sets its own 8-device flag
+    e.update(env)
+    return subprocess.run(
+        [sys.executable, os.path.join(root, "tests",
+                                      "ckpt_elastic_worker.py")],
+        capture_output=True, text=True, env=e, timeout=timeout, cwd=root,
+    )
+
+
+@pytest.mark.chaos
+def test_kill_resume_composed_mesh_matches_single_host_pin(tmp_path):
+    """Hard-kill mid-epoch while training a 2-stage pipeline under
+    dp2,pp2 with sharded v2 checkpoints; the restarted worker must
+    auto-resume from the last commit and reach the SAME convergence pin
+    as the single-host kill-resume test (final_update=48, acc > 0.8)."""
+    d = str(tmp_path / "ckpts")
+    base = {
+        "MXNET_CHECKPOINT_DIR": d,
+        "MXNET_CHECKPOINT_BATCH_PERIOD": "3",
+        "WORKER_MESH": "dp2,pp2",
+    }
+    r1 = _run_elastic_worker({**base, "MXNET_FI_CRASH_AT_BATCH": "20"})
+    assert r1.returncode == 17, (r1.stdout + r1.stderr)[-3000:]
+
+    from mxnet_tpu import checkpoint as ckpt
+    pre = ckpt.load_latest(d)
+    assert pre is not None
+    assert (pre.next_epoch, pre.next_batch) == (2, 3)
+    m = pre.manifest
+    assert m["format"] == 2 and m["mesh"]["spec"] == "dp2,pp2"
+
+    r2 = _run_elastic_worker({**base, "MXNET_FI_CRASH_AT_BATCH": "20",
+                              "MXNET_NUM_RESTARTS": "1"})
+    out = r2.stdout + r2.stderr
+    assert r2.returncode == 0, out[-3000:]
+    assert "RESUME epoch=2 batch=3 num_update=19" in out, out[-3000:]
+    done = [l for l in out.splitlines() if l.startswith("TRAIN-DONE")]
+    assert done, out[-3000:]
+    assert int(done[0].split("final_update=")[1]) == 48
+    assert float(done[0].split("acc=")[1].split()[0]) > 0.8

@@ -26,6 +26,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.dist_multiprocess
 def test_dist_training_converges_identically():
     """dist_lenet analogue: 2 ranks train on disjoint shards through the
     dist kvstore; both converge and end with identical parameters."""
@@ -89,6 +90,7 @@ def test_launcher_detects_and_restarts_dead_worker(tmp_path):
     assert "restart budget spent" in out
 
 
+@pytest.mark.dist_multiprocess
 @pytest.mark.parametrize("nproc", [2, 3])
 def test_dist_sync_kvstore_local_processes(nproc):
     env = dict(os.environ)
@@ -112,6 +114,7 @@ def test_dist_sync_kvstore_local_processes(nproc):
         assert f"rank {r}/{nproc} DIST OK" in out, out[-4000:]
 
 
+@pytest.mark.dist_multiprocess
 def test_mid_training_worker_kill_recovers_and_converges(tmp_path):
     """Fault injection at FULL depth: rank 1 hard-dies (faultinject
     os._exit, no cleanup) in the middle of epoch 3 of a real dist_sync

@@ -124,7 +124,7 @@ def test_lock_discipline_catches_each_rule():
     bad = _fixture("lock_discipline", "bad")
     result = _lint([bad], checks=["lock-discipline"])
     messages = " | ".join(f.message for f in result.findings)
-    for needle in ("cycle", "written", "run lock"):
+    for needle in ("cycle", "written", "run lock", "hand-off lock"):
         assert needle in messages, (
             f"expected a {needle!r} finding in: {messages}")
 

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Checkpoint CLI — inspect, verify, and reshard elastic v2 checkpoints.
+
+Usage:
+    python tools/ckpt.py inspect <dir|commit>            # manifest summary
+    python tools/ckpt.py verify  <dir|commit>            # digest + coverage
+    python tools/ckpt.py reshard <dir|commit> --out DIR [--mesh SPEC]
+
+`inspect` is stdlib-only (reads manifest.json directly). `verify` and
+`reshard` import the framework (JAX_PLATFORMS defaults to cpu) to reuse
+the loader's digest/coverage checks and the elastic reassembly path;
+`reshard` rewrites any source shard layout as a single-shard v2 commit
+stamped for --mesh, so a checkpoint from one topology can be staged for
+another offline, without a training process.
+
+Exit status: 0 clean, 1 corruption / no loadable checkpoint, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def _die(msg, code=1):
+    print(f"ckpt: {msg}", file=sys.stderr)
+    sys.exit(code)
+
+
+def _is_commit(path):
+    return os.path.exists(os.path.join(path, "manifest.json"))
+
+
+def _commits(root):
+    try:
+        names = sorted(os.listdir(root), reverse=True)
+    except OSError as e:
+        _die(f"cannot list {root}: {e}")
+    return [os.path.join(root, n) for n in names
+            if n.startswith("ckpt-") and
+            os.path.isdir(os.path.join(root, n))]
+
+
+def _newest_commit(path):
+    if _is_commit(path):
+        return path
+    commits = [c for c in _commits(path) if _is_commit(c)]
+    if not commits:
+        _die(f"no committed checkpoint under {path}")
+    return commits[0]
+
+
+def _fmt_shape(shape):
+    return "x".join(str(s) for s in shape) if shape else "scalar"
+
+
+def cmd_inspect(args):
+    path = _newest_commit(args.path)
+    with open(os.path.join(path, "manifest.json")) as f:
+        m = json.load(f)
+    print(f"commit:    {os.path.basename(path)}")
+    print(f"format:    v{m.get('format')}")
+    print(f"resume at: epoch {m.get('next_epoch')} "
+          f"batch {m.get('next_batch')}")
+    mesh = m.get("mesh")
+    if mesh:
+        print(f"mesh:      {mesh.get('spec')} "
+              f"({len(mesh.get('devices') or [])} devices, "
+              f"{mesh.get('processes')} process(es), "
+              f"{mesh.get('platform')})")
+    opt = m.get("optimizer") or {}
+    if opt:
+        print(f"optimizer: num_update={opt.get('num_update')}")
+    if m.get("stage_slices"):
+        stages = {v["stage"] for v in m["stage_slices"].values()}
+        print(f"pipeline:  {len(stages)} packed stage(s), "
+              f"{len(m['stage_slices'])} row slice(s)")
+    files = m.get("files") or {}
+    total = sum(v.get("bytes", 0) for v in files.values())
+    print(f"files:     {len(files)} ({total} bytes)")
+    for name in sorted(files):
+        print(f"  {name:32s} {files[name].get('bytes', 0):>12d} bytes")
+    params = m.get("params")
+    if params:
+        shards = m.get("shards") or {}
+        per_param = {}
+        for v in shards.values():
+            if v.get("domain") == "param":
+                per_param[v["name"]] = per_param.get(v["name"], 0) + 1
+        print(f"params:    {len(params)}")
+        for name in sorted(params):
+            p = params[name]
+            spec = p.get("spec") or "replicated"
+            print(f"  {name:28s} {p['kind']:3s} "
+                  f"{_fmt_shape(p.get('shape')):>12s} {p.get('dtype'):>9s} "
+                  f"{per_param.get(name, 0):>3d} piece(s)  {spec}")
+        opt_names = [n for n, t in (m.get('opt_states') or {}).items()
+                     if t is not None]
+        print(f"opt state: {len(opt_names)} parameter(s) with saved "
+              f"slots")
+    return 0
+
+
+def cmd_verify(args):
+    from mxnet_tpu import checkpoint as ckpt
+
+    targets = [args.path] if _is_commit(args.path) else _commits(args.path)
+    if not targets:
+        _die(f"no commit directories under {args.path}")
+    bad = 0
+    for path in targets:
+        name = os.path.basename(path)
+        try:
+            m = ckpt.verify_dir(path)
+            print(f"OK       {name} (v{m['format']}, resume at epoch "
+                  f"{m['next_epoch']} batch {m['next_batch']})")
+        except ckpt.CheckpointCorrupt as e:
+            bad += 1
+            print(f"CORRUPT  {name}: {e}")
+    return 1 if bad else 0
+
+
+def cmd_reshard(args):
+    if args.mesh:
+        # validate the grammar before paying for the load
+        from mxnet_tpu.parallel.mesh import parse_mesh_spec
+        try:
+            parse_mesh_spec(args.mesh, devices=None)
+        except Exception as e:
+            _die(f"bad --mesh {args.mesh!r}: {e}", 2)
+    from mxnet_tpu import checkpoint as ckpt
+
+    if _is_commit(args.path):
+        ckpt.verify_dir(args.path)
+        loaded = ckpt._load_one(args.path)
+    else:
+        loaded = ckpt.load_latest(args.path)
+        if loaded is None:
+            _die(f"no loadable checkpoint under {args.path}")
+    out = ckpt.consolidate(loaded, args.out, mesh_spec=args.mesh)
+    m = ckpt.verify_dir(out)
+    print(f"resharded {os.path.basename(loaded.path)} -> {out} "
+          f"(single shard, {len(m['files'])} files"
+          f"{', mesh ' + args.mesh if args.mesh else ''})")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="ckpt.py", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("inspect", cmd_inspect), ("verify", cmd_verify),
+                     ("reshard", cmd_reshard)):
+        p = sub.add_parser(name)
+        p.add_argument("path", help="checkpoint root or commit directory")
+        p.set_defaults(fn=fn)
+        if name == "reshard":
+            p.add_argument("--out", required=True,
+                           help="output commit directory")
+            p.add_argument("--mesh", default=None,
+                           help="mesh spec to stamp (e.g. dp4,pp2)")
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)  # e.g. `inspect | head`
