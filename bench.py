@@ -77,6 +77,15 @@ batch-1 (the smoke pin in tests/test_bench_smoke.py), and the embedded
 telemetry snapshot must show ``executor.jit_compile == 0`` — the warmed
 request path never compiles.
 
+``BENCH_SERVE_SHARDED=1`` adds the MESH-NATIVE serving legs over a
+tp-annotated MLP: one ``sharded`` sub-record per ``BENCH_SERVE_MESH_LEGS``
+spec (default ``tp2,pp2,dp-tp2`` — single tp2 group, single GPipe pp2
+group, and every tp2 group as a dp replica) with per-leg img/s, p99 and
+``request_path_compiles`` (pinned 0), plus the ``tp2_scaling_curve``
+(throughput at 1/2/4 two-device groups; ``group_scaling_4x`` is the
+ratio the trajectory tracks). Needs >= 8 devices — real chips or
+``--xla_force_host_platform_device_count=8``.
+
 ``BENCH_CHAOS=1`` adds the availability-under-chaos leg: one replica is
 killed (env fault injection) under concurrent traffic, then revived;
 the JSON tail reports ``availability`` (completed/total across
@@ -313,6 +322,84 @@ def _drive_serve_phase(server, samples, clients, per_client, phase):
     return results
 
 
+def _tp_annotated_mlp(mx, in_dim=64, hidden=256, num_classes=16):
+    """Two-layer MLP with explicit column/row tensor-parallel shard
+    annotations — the sharded serving legs' model (resnet carries no
+    ``__shard__`` attributes; this is the canonical Megatron split)."""
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(__shard__="tp:0"):
+        w1 = mx.sym.Variable("fc1_weight")
+    with mx.AttrScope(__shard__="tp:1"):
+        w2 = mx.sym.Variable("fc2_weight")
+    h = mx.sym.FullyConnected(data, weight=w1, num_hidden=hidden,
+                              no_bias=True, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    return mx.sym.FullyConnected(h, weight=w2, num_hidden=num_classes,
+                                 no_bias=True, name="fc2"), (in_dim,)
+
+
+def _run_serve_sharded_legs(mx, clients, per_client):
+    """``BENCH_SERVE_MESH_LEGS``: per-mesh-spec serving legs (``tp2``,
+    ``pp2``, ``dp-tp2`` = every tp2 group as a dp replica) plus the
+    group-replica scaling curve. Each leg reports throughput, p99 and the
+    REQUEST-PATH compile count (must be 0 — the per-bucket sharded
+    executables are all warmed up front)."""
+    from mxnet_tpu.serving import ModelServer, ServingConfig
+
+    sym, shape = _tp_annotated_mlp(mx)
+    rng = np.random.RandomState(2)
+    arg_shapes, _, _ = sym.infer_shape(data=(1,) + shape)
+    params = {n: mx.nd.array(rng.uniform(-0.5, 0.5, s).astype(np.float32))
+              for n, s in zip(sym.list_arguments(), arg_shapes)
+              if n != "data"}
+    samples = [rng.uniform(-1, 1, shape).astype(np.float32)
+               for _ in range(16)]
+    compile_ctr = mx.telemetry.counter("executor.jit_compile")
+
+    def leg(mesh_spec, replicas):
+        srv = ModelServer(
+            sym, {k: v.copy() for k, v in params.items()},
+            {"data": shape},
+            config=ServingConfig(buckets="1,4", mesh=mesh_spec,
+                                 replicas=replicas, fold_bn=False))
+        srv.warmup()
+        srv.start()
+        srv.latency.reset()
+        c0 = compile_ctr.value
+        tic = time.time()
+        results = _drive_serve_phase(srv, samples, clients, per_client,
+                                     f"shard-{mesh_spec}-r{replicas}")
+        wall = time.time() - tic
+        out = {
+            "img_per_sec": round(
+                sum(1 for k, _ in results if k) / wall, 2),
+            "errors": sum(1 for k, _ in results if not k),
+            "replicas": len(srv.replicas),
+            "p99_ms": round(srv.latency.percentile(99) / 1e3, 2),
+            "request_path_compiles": compile_ctr.value - c0,
+        }
+        srv.close()
+        return out
+
+    legs_env = os.environ.get("BENCH_SERVE_MESH_LEGS", "tp2,pp2,dp-tp2")
+    sharded = {}
+    for name in [s.strip() for s in legs_env.split(",") if s.strip()]:
+        if name.startswith("dp-"):
+            # dp-of-<spec>: EVERY group serves (replicas=0 = all)
+            sharded[name] = leg(name[3:], replicas=0)
+        else:
+            sharded[name] = leg(name, replicas=1)
+    # group-replica scaling curve over the dp-of-tp2 layout: throughput
+    # vs number of 2-device groups under the same concurrent load
+    curve = {}
+    for n in (1, 2, 4):
+        curve[n] = leg("tp2", replicas=n)["img_per_sec"]
+    sharded["tp2_scaling_curve"] = curve
+    if curve[1] > 0:
+        sharded["group_scaling_4x"] = round(curve[4] / curve[1], 3)
+    return sharded
+
+
 def _run_serve_chaos(mx, server, samples, clients, per_client):
     """BENCH_CHAOS=1: kill one replica under concurrent traffic (env
     fault injection, runtime-toggled), then revive it — report
@@ -463,6 +550,11 @@ def _run_serve_mode(mx, models, image, num_layers, on_tpu):
         if ok:
             record["replica_scaling"] = round(
                 record["value"] / record["single_replica_img_per_sec"], 3)
+    if os.environ.get("BENCH_SERVE_SHARDED") == "1":
+        # tp/pp group-replica legs + scaling curve (needs a multi-device
+        # mesh: real chips, or --xla_force_host_platform_device_count)
+        record["sharded"] = _run_serve_sharded_legs(mx, clients,
+                                                    per_client)
     if chaos:
         record["chaos"] = _run_serve_chaos(mx, server, samples, clients,
                                            per_client)

@@ -203,6 +203,41 @@ def list_all_op_names():
     return sorted(registry._OPS.keys())
 
 
+def _param_type_info(param):
+    """Render one ``Param`` as a reference-style dmlc type string
+    (``"int, required"`` / ``"boolean, optional, default=False"``)."""
+    parse = param.parse
+    tname = getattr(parse, "__name__", "") or "string"
+    # internal parser helpers (_parse_bool, _parse_shape, ...) read better
+    # under their dmlc spellings
+    tname = {"int": "int", "float": "float", "str": "string"}.get(
+        tname, tname.lstrip("_").replace("parse_", "") or "string")
+    if tname == "bool":
+        tname = "boolean"
+    if param.required:
+        return f"{tname}, required"
+    return f"{tname}, optional, default={param.default!r}"
+
+
+def op_info(op_name):
+    """MXSymbolGetAtomicSymbolInfo: the op's doc plus its PARAMETER
+    schema — name/type/description per dmlc parameter field (the reference
+    describes the op's dmlc::Parameter struct here, not its tensor
+    inputs), and ``key_var_num_args`` for variadic ops (``"num_args"`` for
+    Concat/add_n-style ops, ``""`` otherwise). This is the introspection
+    surface binding generators sit on (tools/gen_cpp_wrappers.py)."""
+    from .ops import registry
+
+    opdef = registry.get(op_name)
+    names, types, descs = [], [], []
+    for key, param in opdef.param_schema.items():
+        names.append(key)
+        types.append(_param_type_info(param))
+        descs.append(param.doc or "")
+    key_var = "num_args" if "num_args" in opdef.param_schema else ""
+    return (opdef.doc or "", names, types, descs, key_var, "")
+
+
 def _imperative_fn(op_name):
     from . import ndarray
 
@@ -359,6 +394,20 @@ def recordio_read(rec):
 
 def recordio_close(rec):
     rec.close()
+    return None
+
+
+def recordio_tell(rec):
+    """MXRecordIOWriterTell: current byte offset (a record boundary when
+    called between writes — the seekable cursor indexed .rec files pair
+    with their .idx sidecar)."""
+    return int(rec.tell())
+
+
+def recordio_seek(rec, pos):
+    """MXRecordIOReaderSeek: reposition a reader to a byte offset captured
+    by tell(); the next read returns the record at that boundary."""
+    rec.seek(int(pos))
     return None
 
 

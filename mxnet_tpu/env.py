@@ -336,6 +336,42 @@ _declare("MXNET_FI_SERVE_RELOAD_CORRUPT", str, "",
          "whose hot reload raises mid-swap — the server must eject that "
          "replica (serving.replica.ejected) and keep the pool serving "
          "the new weights on the others.")
+_declare("MXNET_SERVING_MESH", str, "auto",
+         "Per-replica device-group layout for serving.ModelServer: a "
+         "GraftMesh spec for ONE replica's sub-mesh (axis tokens like "
+         "'tp2', 'pp4', 'tp2,pp2'). The pool partitions the local "
+         "devices into contiguous groups of that size — e.g. 'tp2' on 8 "
+         "devices = 4 group-replicas of 2-device tensor parallelism, "
+         "'pp4' = 2 replicas of 4-stage GPipe — and every replica hosts "
+         "per-bucket sharded predictors on its group. All health/"
+         "failover/hedging machinery applies to group-replicas "
+         "unchanged. 'auto' (default) keeps one-device replicas "
+         "(MXNET_SERVING_REPLICAS semantics).")
+_declare("MXNET_SERVING_SEQ_BUCKETS", str, "",
+         "Comma-separated sequence-length buckets for variable-length "
+         "serving (BucketingModule-style): each request's seq axis "
+         "(MXNET_SERVING_SEQ_AXIS) is zero-padded up to the smallest "
+         "covering bucket and batched only with same-bucket requests; "
+         "warmup() pre-compiles one executable per (batch, seq) bucket "
+         "pair. Requires a sym_gen-style ModelServer symbol (the symbol "
+         "varies with seq_len). Empty (default) = fixed-shape serving.")
+_declare("MXNET_SERVING_SEQ_AXIS", int, 0,
+         "Sample axis (batch axis excluded) that MXNET_SERVING_SEQ_BUCKETS "
+         "buckets on: 0 = first per-sample axis, i.e. dimension 1 of the "
+         "stacked batch — the seq axis of (batch, seq) LSTM inputs.")
+_declare("MXNET_SERVING_CANARY_PCT", float, 0.0,
+         "Percentage of /predict traffic the serving registry routes to "
+         "the registered canary weight set instead of the primary "
+         "(deterministic accumulator split, not random — testable). "
+         "Responses keep each server's own weight-version stamp, so "
+         "clients can see which version answered. 0 (default) = canary "
+         "takes no live traffic.")
+_declare("MXNET_SERVING_SHADOW", int, 0,
+         "Shadow mode for canary serving: 1 duplicates every primary "
+         "request to the registered canary/shadow server and discards "
+         "the shadow response (errors swallowed, counted as "
+         "serving.shadow_error) — the canary sees production traffic "
+         "with zero client impact. 0 (default) = off.")
 _declare("MXNET_SERVING_WATCH", float, 0.0,
          "Seconds between polls of the serving watch directory's LATEST "
          "pointer (a PR-4 checkpoint dir): when it names a new "

@@ -20,5 +20,5 @@ from .resnext import get_symbol as resnext
 from .inception_resnet_v2 import get_symbol as inception_resnet_v2
 from .dcgan import make_generator as dcgan_generator
 from .dcgan import make_discriminator as dcgan_discriminator
-from .lstm_lm import lstm_lm_sym_gen
+from .lstm_lm import lstm_lm_serving_sym_gen, lstm_lm_sym_gen
 from . import ssd

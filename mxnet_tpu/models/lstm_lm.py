@@ -38,3 +38,33 @@ def lstm_lm_sym_gen(num_hidden=200, num_layers=2, num_embed=200,
         n for n in probe.list_arguments() if "begin_state" in n
     ]
     return sym_gen, state_names
+
+
+def lstm_lm_serving_sym_gen(num_hidden=200, num_layers=2, num_embed=200,
+                            vocab_size=10000):
+    """Inference-side ``sym_gen(seq_len)`` for seq-len-bucketed SERVING:
+    the same stacked LSTM LM but label-free and batch-major — output
+    ``(batch, seq_len, vocab)`` logits, so the serving batcher can
+    scatter rows back per request. Pass to
+    ``ModelServer(sym_gen=..., config=ServingConfig(seq_buckets=...))``
+    with ``input_types={"data": "int32"}``."""
+    stack = rnn_mod.SequentialRNNCell()
+    for i in range(num_layers):
+        stack.add(rnn_mod.LSTMCell(num_hidden=num_hidden,
+                                   prefix=f"lstm_l{i}_"))
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        embed = sym.Embedding(
+            data, input_dim=vocab_size, output_dim=num_embed, name="embed"
+        )
+        stack.reset()
+        outputs, _ = stack.unroll(seq_len, inputs=embed,
+                                  merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, num_hidden))
+        pred = sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+        pred = sym.Reshape(pred, shape=(-1, seq_len, vocab_size),
+                           name="logits")
+        return pred, ("data",), ()
+
+    return sym_gen

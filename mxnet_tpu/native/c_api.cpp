@@ -752,6 +752,86 @@ int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
   MXTPU_API_END();
 }
 
+namespace mxtpu {
+// per-thread scratch backing the pointers MXSymbolGetAtomicSymbolInfo
+// returns — valid until the thread's next call, the reference's
+// MXAPIThreadLocalEntry convention
+struct OpInfoScratch {
+  std::string desc, key_var, ret_type;
+  std::vector<std::string> names, types, descs;
+  std::vector<const char*> name_ps, type_ps, desc_ps;
+};
+
+// unpack one python list-of-str into (store, ptrs); false on error
+inline bool info_strs(PyObject* list, std::vector<std::string>& store,
+                      std::vector<const char*>& ptrs) {
+  store.clear();
+  ptrs.clear();
+  Py_ssize_t n = PySequence_Size(list);
+  if (n < 0) return false;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(list, i);
+    const char* c = it ? PyUnicode_AsUTF8(it) : nullptr;
+    if (!c) {
+      Py_XDECREF(it);
+      return false;
+    }
+    store.emplace_back(c);
+    Py_DECREF(it);
+  }
+  for (auto& s : store) ptrs.push_back(s.c_str());
+  return true;
+}
+}  // namespace mxtpu
+
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char** name, const char** description,
+                                uint32_t* num_args, const char*** arg_names,
+                                const char*** arg_type_infos,
+                                const char*** arg_descriptions,
+                                const char** key_var_num_args,
+                                const char** return_type) {
+  MXTPU_GUARD_PTR(name);
+  MXTPU_API_BEGIN();
+  if (!mxtpu::ensure_op_table()) break;
+  size_t idx = (size_t)(uintptr_t)creator;
+  if (idx == 0 || idx > mxtpu::op_table().size()) {
+    g_last_error = "invalid AtomicSymbolCreator";
+    return -1;
+  }
+  const std::string& op = mxtpu::op_table()[idx - 1];
+  PyObject* r = capi_call("op_info", Py_BuildValue("(s)", op.c_str()));
+  if (!r) break;
+  const char* c_desc;
+  const char* c_kv;
+  const char* c_ret;
+  PyObject* l_names;
+  PyObject* l_types;
+  PyObject* l_descs;
+  static thread_local mxtpu::OpInfoScratch scratch;
+  bool ok = PyArg_ParseTuple(r, "sOOOss", &c_desc, &l_names, &l_types,
+                             &l_descs, &c_kv, &c_ret) &&
+            mxtpu::info_strs(l_names, scratch.names, scratch.name_ps) &&
+            mxtpu::info_strs(l_types, scratch.types, scratch.type_ps) &&
+            mxtpu::info_strs(l_descs, scratch.descs, scratch.desc_ps);
+  if (ok) {
+    scratch.desc = c_desc;
+    scratch.key_var = c_kv;
+    scratch.ret_type = c_ret;
+  }
+  Py_DECREF(r);
+  if (!ok) break;
+  *name = op.c_str();
+  if (description) *description = scratch.desc.c_str();
+  if (num_args) *num_args = (uint32_t)scratch.names.size();
+  if (arg_names) *arg_names = scratch.name_ps.data();
+  if (arg_type_infos) *arg_type_infos = scratch.type_ps.data();
+  if (arg_descriptions) *arg_descriptions = scratch.desc_ps.data();
+  if (key_var_num_args) *key_var_num_args = scratch.key_var.c_str();
+  if (return_type) *return_type = scratch.ret_type.c_str();
+  MXTPU_API_END();
+}
+
 int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
                        NDArrayHandle* inputs, int* num_outputs,
                        NDArrayHandle** outputs, int num_params,
@@ -1137,6 +1217,19 @@ int MXRecordIOReaderFree(RecordIOHandle handle) {
   return rc;
 }
 
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t* pos) {
+  MXTPU_GUARD_HANDLE(handle);
+  MXTPU_GUARD_PTR(pos);
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(
+      "recordio_tell", Py_BuildValue("(O)", H(handle)->obj));
+  if (!r) break;
+  *pos = (size_t)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  if (PyErr_Occurred()) break;
+  MXTPU_API_END();
+}
+
 int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char* buf,
                                 size_t size) {
   MXTPU_GUARD_HANDLE(handle);
@@ -1171,6 +1264,17 @@ int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const** buf,
     *buf = H(handle)->json.data();
     *size = (size_t)H(handle)->json.size();
   }
+  MXTPU_API_END();
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  MXTPU_GUARD_HANDLE(handle);
+  MXTPU_API_BEGIN();
+  PyObject* r = capi_call(
+      "recordio_seek",
+      Py_BuildValue("(OK)", H(handle)->obj, (unsigned long long)pos));
+  if (!r) break;
+  Py_DECREF(r);
   MXTPU_API_END();
 }
 

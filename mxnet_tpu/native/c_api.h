@@ -116,6 +116,18 @@ int MXSymbolListAtomicSymbolCreators(uint32_t* out_size,
                                      AtomicSymbolCreator** out_array);
 int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
                                 const char** name);
+/* op doc + PARAMETER schema (the dmlc::Parameter fields, not tensor
+ * inputs) — the introspection surface binding generators sit on
+ * (reference c_api.h:774, cpp-package OpWrapperGenerator.py).
+ * key_var_num_args is "num_args" for variadic ops (Concat/add_n), ""
+ * otherwise; return_type is "" (the reference also leaves it empty). */
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char** name, const char** description,
+                                uint32_t* num_args, const char*** arg_names,
+                                const char*** arg_type_infos,
+                                const char*** arg_descriptions,
+                                const char** key_var_num_args,
+                                const char** return_type);
 /* eager op execution on NDArray handles with string params — the path
  * binding-generated nd.* functions use (reference c_api_ndarray.cc:396).
  * Returned output handles are NEW references the caller must free. */
@@ -272,6 +284,9 @@ int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id,
 /* ---------------- RecordIO (reference MXRecordIO*) ---------------- */
 int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out);
 int MXRecordIOWriterFree(RecordIOHandle handle);
+/* byte-offset cursor: Tell between writes yields a record boundary a
+ * reader can Seek back to (what .idx sidecars store) */
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t* pos);
 int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char* buf,
                                 size_t size);
 int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out);
@@ -281,6 +296,7 @@ int MXRecordIOReaderFree(RecordIOHandle handle);
  * zero-length record returns a non-NULL buf with *size == 0. */
 int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const** buf,
                                size_t* size);
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
 
 /* ---------------- DataIter (reference MXDataIter*) ---------------- */
 int MXListDataIters(uint32_t* out_size, DataIterCreator** out_array);

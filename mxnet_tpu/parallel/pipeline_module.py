@@ -260,6 +260,16 @@ class PipelineEngine:
         # off by default so steady state holds no second parameter copy.
         self.retain_packed = False
         self._packed_params = None
+        # inference param caching (the serving path): packing/stacking the
+        # stage params is O(param tensors) of eager device ops per run —
+        # irrelevant against a train step, but on the request path it IS
+        # the per-batch host cost. With cache_inference_params=True, eval
+        # runs reuse the packed/stacked values until invalidate_params()
+        # (weight hot-swaps must call it; training runs never read the
+        # cache, and a train step invalidates it as a side effect of
+        # writing the executors).
+        self.cache_inference_params = False
+        self._cached_vals = None
 
     def _make_pack_layout(self, is_aux):
         """Static flat layout: per dtype, per stage, the (entry_index,
@@ -853,19 +863,30 @@ class PipelineEngine:
         from ..ndarray import NDArray, array as nd_array
 
         _tm.counter("parallel.pp_run").inc()
-        pvals, avals = self._stage_vals()
-        if not self.homogeneous:
-            # per-stage placement: stage i's params/aux ride row i of the
-            # packed P('pp', dp×tp) buffers, so each device materializes
-            # ~1/(S·dp·tp) of the parameter bytes inside the program
-            pvals = self._pack_rows(pvals, self._param_layout)
-            avals = self._pack_rows(avals, self._aux_layout)
-            self._packed_params = pvals if self.retain_packed else None
+        use_cache = self.cache_inference_params and not is_train
+        if is_train:
+            self._cached_vals = None  # train writes the executors
+        if use_cache and self._cached_vals is not None:
+            pvals, avals = self._cached_vals
+            _tm.counter("parallel.pp_param_cache_hit").inc()
         else:
-            # homogeneous: stacked eagerly here (NOT inside the program —
-            # see the step() comment on the multi-axis SPMD miscompile)
-            pvals = self._stack_stage_vals(pvals)
-            avals = self._stack_stage_vals(avals)
+            pvals, avals = self._stage_vals()
+            if not self.homogeneous:
+                # per-stage placement: stage i's params/aux ride row i of
+                # the packed P('pp', dp×tp) buffers, so each device
+                # materializes ~1/(S·dp·tp) of the parameter bytes inside
+                # the program
+                pvals = self._pack_rows(pvals, self._param_layout)
+                avals = self._pack_rows(avals, self._aux_layout)
+                self._packed_params = pvals if self.retain_packed else None
+            else:
+                # homogeneous: stacked eagerly here (NOT inside the
+                # program — see the step() comment on the multi-axis SPMD
+                # miscompile)
+                pvals = self._stack_stage_vals(pvals)
+                avals = self._stack_stage_vals(avals)
+            if use_cache:
+                self._cached_vals = (pvals, avals)
 
         def as_val(a):
             return a._data if isinstance(a, NDArray) else nd_array(a)._data
@@ -943,6 +964,11 @@ class PipelineEngine:
             out.append(self._unpack_row(layout["per_stage"][i], local,
                                         layout["n_entries"][i]))
         return tuple(out)
+
+    def invalidate_params(self):
+        """Drop the inference param cache: the next eval run re-reads the
+        child executors (hot weight swaps call this after writing them)."""
+        self._cached_vals = None
 
     @property
     def outputs(self):

@@ -107,18 +107,17 @@ def collect_shard_specs(symbol):
 def shard_spec_sharding(mesh, spec, ndim):
     """NamedSharding for (mesh_axis, dim) over ``mesh`` (GraftMesh or raw
     Mesh); replicated when the dim is outside the array's rank (biases
-    under a layer-wide scope)."""
+    under a layer-wide scope) or when the mesh has no such axis (a
+    tp-annotated model bound on a pp-only or single-axis mesh runs
+    unsharded rather than refusing — the annotation is a capability, not
+    a requirement)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from .mesh import as_graft
 
     mesh = as_graft(mesh).mesh
     axis, dim = spec
-    if axis not in mesh.axis_names:
-        raise MXNetError(
-            f"__shard__ axis {axis!r} not in mesh axes {mesh.axis_names}"
-        )
-    if dim >= ndim:
+    if axis not in mesh.axis_names or dim >= ndim:
         return NamedSharding(mesh, P())
     return NamedSharding(mesh, P(*((None,) * dim + (axis,))))
 

@@ -21,6 +21,7 @@ performs the whole cycle under the lock and returns numpy outputs.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 
 import numpy as np
@@ -37,10 +38,16 @@ class Predictor:
 
     def __init__(self, symbol_json_or_file, param_source, input_shapes,
                  ctx=None, dev_type="cpu", dev_id=0, output_index=None,
-                 fold_bn=True, input_types=None):
+                 fold_bn=True, input_types=None, mesh=None):
         from .symbol import Symbol
+        from .parallel.mesh import as_graft
 
         self._lock = threading.RLock()
+        # sharded inference: a GraftMesh whose devices this predictor's
+        # program spans — inputs batch-sharded over dp, params placed by
+        # their __shard__ specs (tp NamedShardings), everything else
+        # replicated. None = the classic single-device predictor.
+        self._mesh = as_graft(mesh)
 
         if isinstance(symbol_json_or_file, Symbol):
             symbol = symbol_json_or_file
@@ -101,7 +108,69 @@ class Predictor:
                 pass  # malformed/partial param sets: predict unfolded
         self._bind()
 
+    def _mesh_ctx(self):
+        """Install this predictor's mesh (no-op without one): executor
+        programs are keyed on — and traced under — the ambient mesh, so
+        bind/compile/forward must all run with the same mesh current or
+        the warmed program and the request-path program would differ."""
+        from .parallel.mesh import with_mesh
+
+        if self._mesh is None:
+            return contextlib.nullcontext()
+        return with_mesh(self._mesh)
+
+    def _in_shardings(self):
+        """Input/parameter NamedShardings for a mesh-bound predictor: the
+        executor_group placement recipe, inference-side — data inputs
+        batch-sharded over dp, ``__shard__``-annotated params split by
+        their spec (tp), every other argument replicated."""
+        from .parallel.tensor_parallel import (
+            collect_shard_specs, shard_spec_sharding)
+
+        specs = collect_shard_specs(self.symbol)
+        arg_names = self.symbol.list_arguments()
+        arg_shapes, _ = self._infer_shapes()
+        shape_of = dict(zip(arg_names, arg_shapes))
+        shardings = {}
+        for name in arg_names:
+            if name in self.input_shapes:
+                shardings[name] = self._mesh.batch_sharding()
+            elif name in specs:
+                shardings[name] = shard_spec_sharding(
+                    self._mesh, specs[name], len(shape_of[name] or ()))
+            else:
+                shardings[name] = self._mesh.replicated()
+        return shardings
+
     def _bind(self):
+        with self._mesh_ctx():
+            self._bind_impl()
+
+    def _infer_shapes(self):
+        """``(arg_shapes, aux_shapes)`` for the bound input shapes,
+        completing partial ``__shape__`` hints (0 = batch, the reference
+        0-dim convention) on extra input args — RNN begin states etc. —
+        with the inputs' batch size, same as the Module binder: an LSTM
+        ``sym_gen`` symbol binds as a predictor without the caller
+        naming its states."""
+        from .base import parse_shape
+
+        shape_kwargs = dict(self.input_shapes)
+        attrs = self.symbol.attr_dict()
+        bsz = next(iter(self.input_shapes.values()))[0]
+        for name in self.symbol.list_arguments():
+            if name in shape_kwargs or name in self.arg_params:
+                continue
+            hint = (attrs.get(name) or {}).get("__shape__")
+            if hint:
+                s = parse_shape(hint)
+                if s:
+                    shape_kwargs[name] = tuple(
+                        bsz if d == 0 else d for d in s)
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shape_kwargs)
+        return arg_shapes, aux_shapes
+
+    def _bind_impl(self):
         arg_names = self.symbol.list_arguments()
         # re-binds (reshape) take caller-supplied shape dicts: an unknown
         # key would otherwise vanish into infer_shape's kwargs and leave
@@ -111,7 +180,7 @@ class Predictor:
             raise MXNetError(
                 f"input_shapes names {sorted(unknown)} are not arguments "
                 f"of this symbol (arguments: {arg_names})")
-        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**self.input_shapes)
+        arg_shapes, aux_shapes = self._infer_shapes()
         aux_names = self.symbol.list_auxiliary_states()
         args = {}
         for name, shape in zip(arg_names, arg_shapes):
@@ -138,8 +207,27 @@ class Predictor:
                 auxs[name] = self.aux_params[name].as_in_context(self.ctx)
             else:
                 auxs[name] = zeros(shape, ctx=self.ctx)
+        in_shardings = None
+        if self._mesh is not None:
+            import jax
+
+            in_shardings = self._in_shardings()
+            # pre-place the bound stores: forward device_puts inputs by
+            # sharding, but params/aux placed once here stay resident in
+            # their sharded layout instead of re-spreading per call. The
+            # placed value gets a FRESH handle — as_in_context returns
+            # the caller's own NDArray when already on ctx, and mutating
+            # that in place would reshard every other predictor sharing
+            # the param store (group replicas share one host param set)
+            args = {name: NDArray(jax.device_put(
+                        arr._data, in_shardings[name]), ctx=self.ctx)
+                    for name, arr in args.items()}
+            auxs = {name: NDArray(jax.device_put(
+                        arr._data, self._mesh.replicated()), ctx=self.ctx)
+                    for name, arr in auxs.items()}
         self._exec = Executor(
-            self.symbol, self.ctx, args=args, grad_req="null", aux_states=auxs
+            self.symbol, self.ctx, args=args, grad_req="null",
+            aux_states=auxs, in_shardings=in_shardings,
         )
 
     def reshape(self, input_shapes):
@@ -171,13 +259,36 @@ class Predictor:
             if not isinstance(data, NDArray):
                 data = array(np.asarray(data), dtype=np_dtype(tgt.dtype))
             data.copyto(tgt)  # copyto casts NDArray sources to tgt dtype
+            if self._mesh is not None:
+                # copyto lands a single-device array; the sharded program
+                # requires its inputs placed by the compiled in_shardings
+                import jax
+
+                tgt._data = jax.device_put(
+                    tgt._data, self._exec._in_shardings[name])
 
     def forward(self, **kwargs):
         with self._lock:
             for k, v in kwargs.items():
                 self.set_input(k, v)
             self._partial_outs = None
-            self._exec.forward(is_train=False)
+            with self._mesh_ctx():
+                self._exec.forward(is_train=False)
+
+    def compile(self, kinds=("forward",)):
+        """AOT-warm this predictor's programs (Executor.compile) under its
+        mesh, so a mesh-sharded serve program is compiled exactly as the
+        request path will run it — same mesh in the program cache key."""
+        with self._lock, self._mesh_ctx():
+            return self._exec.compile(list(kinds))
+
+    def input_dtypes(self):
+        """Bound numpy dtype per input name (the serving admission
+        coercion contract; ``np_dtype`` handles framework dtypes like
+        bfloat16 that numpy's parser does not know)."""
+        with self._lock:
+            return {n: np_dtype(self._exec.arg_dict[n].dtype)
+                    for n in self.input_shapes}
 
     def run(self, **inputs):
         """Atomic set-inputs → forward → fetch: the whole cycle under the
@@ -234,6 +345,19 @@ class Predictor:
             for tgt, name, v in aux_swaps:
                 v.copyto(tgt)
                 self.aux_params[name] = v
+            if self._mesh is not None:
+                # restore the sharded layout the program was compiled
+                # against: copyto lands host values as single-device
+                # arrays, and a placement change would force recompiles
+                import jax
+
+                for tgt, name, _ in arg_swaps:
+                    tgt._data = jax.device_put(
+                        tgt._data, self._exec._in_shardings.get(
+                            name, self._mesh.replicated()))
+                for tgt, _name, _ in aux_swaps:
+                    tgt._data = jax.device_put(
+                        tgt._data, self._mesh.replicated())
             self._partial_outs = None
 
     @staticmethod

@@ -117,6 +117,14 @@ class MXRecordIO:
     def tell(self):
         return self.handle.tell()
 
+    def seek(self, pos):
+        """Reposition a READER to a byte offset previously captured with
+        :meth:`tell` (reference ``MXRecordIOReaderSeek``) — a valid target
+        is always a record boundary, so the next :meth:`read` returns that
+        record. Writers only append; seeking one is an error."""
+        assert not self.writable
+        self.handle.seek(int(pos))
+
 
 class MXIndexedRecordIO(MXRecordIO):
     """Indexed RecordIO with ``.idx`` sidecar (reference MXIndexedRecordIO)."""

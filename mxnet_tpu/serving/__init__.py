@@ -27,6 +27,22 @@ TPU serving is won one layer up, where this package lives:
   (``MXNET_SERVING_HEDGE_MS``), and proportional admission shedding as
   healthy capacity drops (all-down fails fast with
   :class:`NoHealthyReplicas`, never a hang).
+- With ``MXNET_SERVING_MESH`` the pool goes MESH-NATIVE: local devices
+  partition into :class:`~mxnet_tpu.parallel.GraftMesh` sub-meshes
+  (``tp2`` → 2-device tensor-parallel groups, ``pp2`` → GPipe stage
+  pairs) and every replica hosts per-bucket SHARDED predictors over its
+  device group (``serving/sharded.py``) — the same health/failover/
+  hedging machinery composes unchanged over group-replicas, so one
+  process serves big sharded models and small replicated ones under one
+  admission layer.
+- ``MXNET_SERVING_SEQ_BUCKETS`` adds a second bucketing axis for
+  variable-length sequence models: requests pad to (batch, seq-len)
+  buckets routed to per-bucket BucketingModule-style predictors from a
+  ``sym_gen`` callable — the LSTM/PTB serving path.
+- :class:`ModelRegistry` hosts many named models in one process
+  (``POST /predict/{model}``) with per-model hot reload and
+  canary/shadow routing between weight versions
+  (``MXNET_SERVING_CANARY_PCT`` / ``MXNET_SERVING_SHADOW``).
 - :func:`serve_http` / ``tools/serve.py`` expose it over a stdlib
   threaded HTTP frontend (``POST /predict``, ``GET /healthz`` —
   readiness-aware: 503 when no replica is healthy, ``degraded: true``
@@ -41,13 +57,16 @@ from .errors import (DeadlineExceeded, NoHealthyReplicas, ReplicaTimeout,
                      WorkerCrashed)
 from .http import make_http_server, serve_http
 from .metrics import LatencyHistogram
+from .registry import ModelRegistry
 from .replica import Replica, ReplicaPool
 from .server import ModelServer, ServingConfig
+from .sharded import PipelinePredictor, partition_devices
 
 __all__ = [
-    "DynamicBatcher", "LatencyHistogram", "ModelServer", "Replica",
+    "DynamicBatcher", "LatencyHistogram", "ModelRegistry", "ModelServer",
+    "PipelinePredictor", "Replica",
     "ReplicaPool", "ServingConfig",
     "ServingError", "ServerOverloaded", "DeadlineExceeded", "ServerClosed",
     "NoHealthyReplicas", "ReplicaTimeout", "WorkerCrashed",
-    "make_http_server", "serve_http",
+    "make_http_server", "partition_devices", "serve_http",
 ]

@@ -65,13 +65,17 @@ _LOG = logging.getLogger("mxnet_tpu.serving")
 
 
 class _Request:
-    __slots__ = ("inputs", "future", "t_enqueue", "deadline")
+    __slots__ = ("inputs", "future", "t_enqueue", "deadline", "group")
 
-    def __init__(self, inputs, deadline):
+    def __init__(self, inputs, deadline, group=None):
         self.inputs = inputs
         self.future = Future()
         self.t_enqueue = time.monotonic()
         self.deadline = deadline  # absolute monotonic seconds, or None
+        # second bucketing axis (seq-len bucket): only requests sharing a
+        # group coalesce into one batch; None = ungrouped (plain int
+        # bucket keys, the historical contract)
+        self.group = group
 
 
 def _fail(future, exc):
@@ -220,17 +224,19 @@ class DynamicBatcher:
             self._dispatch_pool = None
 
     # -- admission -----------------------------------------------------
-    def submit(self, inputs, deadline=None):
+    def submit(self, inputs, deadline=None, group=None):
         """Enqueue one request; returns its ``concurrent.futures.Future``.
 
         ``inputs``: dict name -> per-sample numpy array (already validated
         and dtype-coerced by the caller). ``deadline``: absolute
         ``time.monotonic()`` seconds after which the request is dropped
-        unserved, or None. Raises :class:`ServerClosed` /
-        :class:`NoHealthyReplicas` / :class:`ServerOverloaded` without
-        queueing.
+        unserved, or None. ``group``: second bucketing axis (the seq-len
+        bucket) — only same-group requests coalesce, and the runner is
+        keyed ``(bucket, group)`` instead of the plain int bucket. Raises
+        :class:`ServerClosed` / :class:`NoHealthyReplicas` /
+        :class:`ServerOverloaded` without queueing.
         """
-        req = _Request(inputs, deadline)
+        req = _Request(inputs, deadline, group)
         depth_limit = self.queue_depth
         if self._capacity_fn is not None:
             frac = self._capacity_fn()
@@ -295,8 +301,18 @@ class DynamicBatcher:
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
-            take = min(len(self._queue), max_b)
-            reqs = [self._queue.popleft() for _ in range(take)]
+            # only the head request's group coalesces (same compiled
+            # seq-len shape); other groups stay queued, order preserved,
+            # for the next iteration
+            head_group = self._queue[0].group
+            reqs, skipped = [], collections.deque()
+            while self._queue and len(reqs) < max_b:
+                r = self._queue.popleft()
+                if r.group == head_group:
+                    reqs.append(r)
+                else:
+                    skipped.append(r)
+            self._queue.extendleft(reversed(skipped))
             _tm.gauge("serving.queue_depth").set(len(self._queue))
         return reqs
 
@@ -356,14 +372,22 @@ class DynamicBatcher:
 
     def _run_batch(self, reqs):
         n = len(reqs)
-        bucket = self._pick_bucket(n)
+        bsize = self._pick_bucket(n)
+        if reqs[0].group is not None:
+            # composite program key: (batch bucket, seq-len bucket) — the
+            # runner's predictor tables are keyed the same way. Ungrouped
+            # requests keep the plain int key (historical contract relied
+            # on by tests that patch bare runners).
+            bucket = (bsize, reqs[0].group)
+        else:
+            bucket = bsize
         try:
             stacked = {}
             for name, sample in reqs[0].inputs.items():
                 rows = [r.inputs[name] for r in reqs]
                 batch = np.stack(rows)
-                if n < bucket:
-                    pad = np.zeros((bucket - n,) + sample.shape,
+                if n < bsize:
+                    pad = np.zeros((bsize - n,) + sample.shape,
                                    dtype=sample.dtype)
                     batch = np.concatenate([batch, pad])
                 stacked[name] = batch
@@ -429,9 +453,10 @@ class DynamicBatcher:
         finally:
             self._tl.deadline = None
         outs = res[0] if self._is_noted(res) else res
+        bsize = bucket[0] if isinstance(bucket, tuple) else bucket
         _tm.counter("serving.batches").inc()
         _tm.histogram("serving.batch_size").observe(n)
-        _tm.histogram("serving.pad_waste").observe(bucket - n)
+        _tm.histogram("serving.pad_waste").observe(bsize - n)
         done = time.monotonic()
         for i, r in enumerate(reqs):
             lat_us = (done - r.t_enqueue) * 1e6

@@ -2,7 +2,9 @@
 
 One thread per connection (``ThreadingHTTPServer``); every request thread
 just validates, submits to the batcher and blocks on its future — the
-batching layer, not the HTTP layer, owns concurrency. Endpoints:
+batching layer, not the HTTP layer, owns concurrency. The frontend wraps
+either a single :class:`ModelServer` or a :class:`ModelRegistry`
+(multi-model hosting + canary/shadow routing). Endpoints:
 
 - ``POST /predict`` — ``application/json`` body ``{"inputs": {name:
   nested-list}, "deadline_ms": optional}`` (or the inputs dict directly);
@@ -11,6 +13,10 @@ batching layer, not the HTTP layer, owns concurrency. Endpoints:
   little-endian sample bytes in the input's bound dtype; with ``Accept:
   application/octet-stream`` the response is output 0's raw float32 bytes
   (``X-Output-Shape`` header).
+- ``POST /predict/{model}`` — the same, against the named model of a
+  registry (404 for unknown names; plain ``/predict`` still works when
+  exactly one model is registered). Canary/shadow routing applies — the
+  response's ``version`` stamp tells which weight set answered.
 - ``GET /healthz`` — readiness-aware ``ModelServer.stats()`` JSON: 200
   when serving (``degraded: true`` and per-replica states when only part
   of the replica pool is healthy), 503 with the same body while draining
@@ -49,6 +55,11 @@ _LOG = logging.getLogger("mxnet_tpu.serving.http")
 
 
 def _make_handler(model_server):
+    from .registry import ModelRegistry
+
+    registry = (model_server
+                if isinstance(model_server, ModelRegistry) else None)
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         server_version = "mxnet-tpu-serving"
@@ -81,14 +92,44 @@ def _make_handler(model_server):
                 # readiness: "degraded" still serves (200 + degraded flag
                 # in the body, so an LB can weigh the process down);
                 # "unavailable" (zero healthy replicas) and "draining"
-                # are 503 WITH the body — the why rides along
+                # are 503 WITH the body — the why rides along. A
+                # registry reports the worst primary's status the same
+                # way.
                 code = 200 if stats["status"] in ("ok", "degraded") else 503
                 self._send(code, stats)
             elif self.path == "/metrics":
-                self._send(200, _tm.prometheus(),
-                           ctype="text/plain; version=0.0.4")
+                text = _tm.prometheus()
+                if registry is not None:
+                    # per-model labeled lines (the PR-2 registry is
+                    # label-free by design; model labels live here)
+                    text = text + registry.prometheus()
+                self._send(200, text, ctype="text/plain; version=0.0.4")
             else:
                 self._error(404, f"unknown path {self.path}")
+
+        # -- model resolution ------------------------------------------
+        @staticmethod
+        def _route(path):
+            """``/predict`` → None (default model), ``/predict/{name}``
+            → name; anything else raises (the caller 404s)."""
+            if path == "/predict":
+                return None
+            if path.startswith("/predict/"):
+                name = path[len("/predict/"):]
+                if name and "/" not in name:
+                    return name
+            raise MXNetError(f"unknown path {path}")
+
+        @staticmethod
+        def _target_for(path):
+            name = Handler._route(path)
+            if registry is not None:
+                return registry.resolve(name)
+            if name is not None:
+                raise MXNetError(
+                    f"unknown path {path} (single-model server; "
+                    "POST /predict)")
+            return model_server
 
         # -- POST ------------------------------------------------------
         def do_POST(self):  # noqa: N802
@@ -102,7 +143,17 @@ def _make_handler(model_server):
                 self._error(400, "malformed Content-Length header",
                             headers={"Connection": "close"})
                 return
-            cap = model_server.config.max_body_bytes
+            try:
+                name = self._route(self.path)
+                target = self._target_for(self.path)
+            except MXNetError as e:
+                # drain the body first: on a keep-alive (HTTP/1.1)
+                # connection an unread body would be parsed as the NEXT
+                # request line, corrupting the connection for the client
+                self.rfile.read(length)
+                self._error(404, str(e))
+                return
+            cap = target.config.max_body_bytes
             if cap and length > cap:
                 # refuse from the declared length BEFORE reading: the
                 # whole point of the cap is that an oversized body never
@@ -115,20 +166,23 @@ def _make_handler(model_server):
                             f"{cap}-byte cap (MXNET_SERVING_MAX_BODY_"
                             "BYTES)", headers={"Connection": "close"})
                 return
-            if self.path != "/predict":
-                # drain the body first: on a keep-alive (HTTP/1.1)
-                # connection an unread body would be parsed as the NEXT
-                # request line, corrupting the connection for the client
-                self.rfile.read(length)
-                self._error(404, f"unknown path {self.path}")
-                return
             _tm.counter("serving.http.request").inc()
             try:
                 body = self.rfile.read(length)
                 ctype = (self.headers.get("Content-Type") or
                          "application/json").split(";")[0].strip()
-                inputs, deadline_ms, raw_out = self._parse(body, ctype)
-                fut = model_server.submit(inputs, deadline_ms=deadline_ms)
+                inputs, deadline_ms, raw_out = self._parse(
+                    body, ctype, target)
+                if registry is not None:
+                    # route through the registry so canary/shadow apply
+                    # (resolve() above guarantees a lone model when the
+                    # path named none)
+                    if name is None:
+                        name = registry.names()[0]
+                    fut = registry.submit(name, inputs,
+                                          deadline_ms=deadline_ms)
+                else:
+                    fut = target.submit(inputs, deadline_ms=deadline_ms)
                 outs = fut.result()
             except ServerOverloaded as e:
                 _tm.counter("serving.http.shed").inc()
@@ -174,22 +228,24 @@ def _make_handler(model_server):
                         "outputs": [o.tolist() for o in outs],
                         "shapes": [list(o.shape) for o in outs],
                         # the version the BATCH computed against (stamped
-                        # under the run lock) — model_server.version may
-                        # already have moved on under concurrent reload
+                        # under the run lock) — the server's version may
+                        # already have moved on under concurrent reload.
+                        # With a canary split this is the CANARY's
+                        # version when the router sent the request there
                         "version": getattr(fut, "version",
-                                           model_server.version),
+                                           target.version),
                     })
 
-        def _parse(self, body, ctype):
+        def _parse(self, body, ctype, target):
             raw_out = "application/octet-stream" in (
                 self.headers.get("Accept") or "")
             if ctype == "application/octet-stream":
-                names = model_server._input_names
+                names = target._input_names
                 name = self.headers.get("X-Input-Name") or names[0]
                 if name not in names:
                     raise MXNetError(f"unknown input {name!r}")
-                shape = model_server._sample_shapes[name]
-                dtype = model_server._input_dtypes[name]
+                shape = target._sample_shapes[name]
+                dtype = target._input_dtypes[name]
                 arr = np.frombuffer(body, dtype=dtype)
                 if arr.size != int(np.prod(shape)):
                     raise MXNetError(
@@ -220,19 +276,22 @@ class _ServingHTTPServer(ThreadingHTTPServer):
 
 def make_http_server(model_server, host="0.0.0.0", port=8080):
     """A ``ThreadingHTTPServer`` bound to ``host:port`` and wired to
-    ``model_server`` (not yet serving — call ``serve_forever`` or use
-    :func:`serve_http`)."""
+    ``model_server`` — a single :class:`ModelServer` or a
+    :class:`ModelRegistry` (not yet serving — call ``serve_forever`` or
+    use :func:`serve_http`)."""
     return _ServingHTTPServer((host, port), _make_handler(model_server))
 
 
 def serve_http(model_server, host="0.0.0.0", port=8080):
-    """Start the model server and block serving HTTP until interrupted;
-    drains gracefully on shutdown (queued requests complete, the listener
-    refuses new ones)."""
+    """Start the model server (or registry) and block serving HTTP until
+    interrupted; drains gracefully on shutdown (queued requests complete,
+    the listener refuses new ones)."""
     model_server.start()
     httpd = make_http_server(model_server, host, port)
-    _LOG.info("serving on http://%s:%d (buckets %s)", host, port,
-              list(model_server.config.buckets))
+    cfg = getattr(model_server, "config", None)
+    _LOG.info("serving on http://%s:%d (%s)", host, port,
+              f"buckets {list(cfg.buckets)}" if cfg is not None
+              else f"models {model_server.names()}")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

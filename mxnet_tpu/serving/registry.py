@@ -1,0 +1,294 @@
+"""Multi-model hosting with canary/shadow routing between weight versions.
+
+A :class:`ModelRegistry` maps model NAMES to :class:`ModelServer`\\ s so
+one process (and one HTTP frontend, ``POST /predict/{model}``) hosts many
+models, each with its own buckets, replica pool, admission queue and hot
+reload — per-model blast radius, shared nothing on the request path.
+
+Each model may additionally carry a **canary**: a second ``ModelServer``
+holding a candidate weight set over the same graph. Two rollout modes:
+
+- **Canary split** (``MXNET_SERVING_CANARY_PCT`` or
+  ``register(canary_pct=...)``): a deterministic accumulator routes that
+  percentage of requests to the canary — no RNG, so the split is exact in
+  the long run and reproducible in tests. Responses ride the existing
+  weight-version stamp (the future's ``version`` attribute, set by the
+  replica pool under the serving replica's lock), so a client — and the
+  canary-analysis job reading logs — can tell which weight set produced
+  every answer.
+- **Shadow** (``MXNET_SERVING_SHADOW=1`` or ``register(shadow=True)``):
+  every primary request is DUPLICATED to the canary; the client always
+  gets the primary's answer, the shadow's result is discarded and its
+  failures are only counted (``serving.shadow_error``) — a dress
+  rehearsal under real traffic with zero client-visible risk.
+
+Per-model observability: ``registry.prometheus()`` renders labeled
+Prometheus lines (``mxnet_serving_model_requests_total{model="x"}`` …)
+that the HTTP ``/metrics`` endpoint appends to the framework registry's
+output — model labels live here because the PR-2 telemetry registry is
+deliberately label-free.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from .. import env as _env
+from .. import telemetry as _tm
+from ..base import MXNetError
+
+__all__ = ["ModelRegistry"]
+
+_LOG = logging.getLogger("mxnet_tpu.serving")
+
+
+class _PctRouter:
+    """Deterministic traffic split: an accumulator gains ``pct`` per
+    request and emits True each time it crosses 100 — the exact fraction
+    with no RNG (a 25% canary gets request 4, 8, 12, …)."""
+
+    __slots__ = ("pct", "_acc", "_lock")
+
+    def __init__(self, pct):
+        self.pct = max(0.0, min(100.0, float(pct)))
+        self._acc = 0.0
+        self._lock = threading.Lock()
+
+    def take(self):
+        if self.pct <= 0.0:
+            return False
+        with self._lock:
+            self._acc += self.pct
+            if self._acc >= 100.0:
+                self._acc -= 100.0
+                return True
+            return False
+
+
+class _Entry:
+    __slots__ = ("name", "primary", "canary", "shadow", "router",
+                 "requests", "canary_routed", "shadow_errors")
+
+    def __init__(self, name, primary, canary, shadow, router):
+        self.name = name
+        self.primary = primary
+        self.canary = canary
+        self.shadow = bool(shadow)
+        self.router = router
+        self.requests = 0
+        self.canary_routed = 0
+        self.shadow_errors = 0
+
+
+class ModelRegistry:
+    """Named :class:`ModelServer`\\ s behind one request/metrics surface.
+
+    Thread safety: registration and lookup share an RLock; the request
+    path holds it only to resolve the entry — inference itself runs on
+    the resolved server's own machinery.
+    """
+
+    def __init__(self, logger=None):
+        self.logger = logger or _LOG
+        self._lock = threading.RLock()
+        self._entries = {}
+
+    # -- registration --------------------------------------------------
+    def register(self, name, server, canary=None, canary_pct=None,
+                 shadow=None):
+        """Host ``server`` under ``name``. ``canary`` is an optional
+        second ModelServer (candidate weights, same input contract);
+        ``canary_pct`` (default ``MXNET_SERVING_CANARY_PCT``) routes that
+        share of traffic to it; ``shadow`` (default
+        ``MXNET_SERVING_SHADOW``) duplicates primary traffic to it
+        instead of splitting."""
+        name = str(name)
+        if not name or "/" in name:
+            raise MXNetError(f"bad model name {name!r}")
+        if canary_pct is None:
+            canary_pct = _env.get("MXNET_SERVING_CANARY_PCT")
+        if shadow is None:
+            shadow = bool(int(_env.get("MXNET_SERVING_SHADOW")))
+        if canary is None and (float(canary_pct) > 0 or shadow):
+            raise MXNetError(
+                f"model {name!r}: canary_pct/shadow configured but no "
+                "canary server given")
+        with self._lock:
+            if name in self._entries:
+                raise MXNetError(f"model {name!r} already registered")
+            self._entries[name] = _Entry(
+                name, server, canary, shadow, _PctRouter(canary_pct))
+        self.logger.info(
+            "serving: registered model %r%s", name,
+            f" (canary: {'shadow' if shadow else f'{canary_pct}%'})"
+            if canary is not None else "")
+        return self
+
+    def unregister(self, name, close=True):
+        """Remove a model; ``close=True`` also drains its server(s)."""
+        with self._lock:
+            e = self._entries.pop(name, None)
+        if e is None:
+            raise MXNetError(f"unknown model {name!r}")
+        if close:
+            e.primary.close()
+            if e.canary is not None:
+                e.canary.close()
+
+    def names(self):
+        with self._lock:
+            return sorted(self._entries)
+
+    def get(self, name):
+        """The primary ModelServer for ``name``."""
+        return self._entry(name).primary
+
+    def _entry(self, name):
+        with self._lock:
+            e = self._entries.get(name)
+        if e is None:
+            raise MXNetError(f"unknown model {name!r} "
+                             f"(registered: {self.names()})")
+        return e
+
+    def resolve(self, name=None):
+        """The entry's primary server; ``name=None`` works when exactly
+        one model is registered (the single-model HTTP fallback)."""
+        if name is not None:
+            return self.get(name)
+        with self._lock:
+            if len(self._entries) == 1:
+                return next(iter(self._entries.values())).primary
+        raise MXNetError(
+            f"{len(self.names())} models registered "
+            f"({self.names()}); name one (POST /predict/{{model}})")
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self):
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            e.primary.start()
+            if e.canary is not None:
+                e.canary.start()
+        return self
+
+    def close(self, drain=True, timeout=30.0):
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            e.primary.close(drain=drain, timeout=timeout)
+            if e.canary is not None:
+                e.canary.close(drain=drain, timeout=timeout)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- request path --------------------------------------------------
+    def submit(self, name, inputs, deadline_ms=None):
+        """Admit one request for ``name``, applying canary/shadow
+        routing. Returns the future whose result the client gets (the
+        canary's when the split routed there, the primary's always in
+        shadow mode)."""
+        e = self._entry(name)
+        e.requests += 1
+        if e.canary is not None and not e.shadow and e.router.take():
+            e.canary_routed += 1
+            _tm.counter("serving.canary_route").inc()
+            return e.canary.submit(inputs, deadline_ms=deadline_ms)
+        fut = e.primary.submit(inputs, deadline_ms=deadline_ms)
+        if e.canary is not None and e.shadow:
+            self._shadow(e, inputs, deadline_ms)
+        return fut
+
+    def predict(self, name, inputs, timeout=None, deadline_ms=None):
+        return self.submit(name, inputs,
+                           deadline_ms=deadline_ms).result(timeout)
+
+    def _shadow(self, e, inputs, deadline_ms):
+        # the duplicate must never affect the primary response: admission
+        # failures and inference errors alike are swallowed and counted
+        try:
+            sfut = e.canary.submit(inputs, deadline_ms=deadline_ms)
+        except Exception:  # noqa: BLE001 — shadow risk is count-only
+            e.shadow_errors += 1
+            _tm.counter("serving.shadow_error").inc()
+            return
+        sfut.add_done_callback(lambda f: self._shadow_done(e, f))
+
+    def _shadow_done(self, e, fut):
+        if fut.cancelled() or fut.exception() is not None:
+            e.shadow_errors += 1
+            _tm.counter("serving.shadow_error").inc()
+
+    # -- reload / introspection ----------------------------------------
+    def reload(self, name, source=None, canary=False):
+        """Per-model hot reload: swap weights on ``name``'s primary (or
+        its canary with ``canary=True``) — other models keep serving
+        untouched."""
+        e = self._entry(name)
+        srv = e.canary if canary else e.primary
+        if srv is None:
+            raise MXNetError(f"model {name!r} has no canary")
+        return srv.reload(source)
+
+    def stats(self):
+        """Aggregate health payload: per-model ``ModelServer.stats()``
+        plus routing counters; ``status`` is the worst primary status
+        (a draining/unavailable model makes the process not-ready)."""
+        with self._lock:
+            entries = list(self._entries.values())
+        models, worst = {}, "ok"
+        rank = {"ok": 0, "degraded": 1, "warming": 2, "draining": 3,
+                "unavailable": 3}
+        for e in entries:
+            p = e.primary.stats()
+            models[e.name] = {
+                "primary": p,
+                "canary": (e.canary.stats()
+                           if e.canary is not None else None),
+                "canary_pct": e.router.pct,
+                "shadow": e.shadow,
+                "requests": e.requests,
+                "canary_routed": e.canary_routed,
+                "shadow_errors": e.shadow_errors,
+            }
+            if rank.get(p["status"], 3) > rank[worst]:
+                worst = ("unavailable"
+                         if rank.get(p["status"], 3) >= 3 else p["status"])
+        return {"status": worst, "models": models}
+
+    def prometheus(self):
+        """Labeled per-model Prometheus lines (appended to the
+        framework registry's ``/metrics`` output by the HTTP layer)."""
+        lines = [
+            "# TYPE mxnet_serving_model_requests_total counter",
+            "# TYPE mxnet_serving_model_canary_routed_total counter",
+            "# TYPE mxnet_serving_model_shadow_errors_total counter",
+            "# TYPE mxnet_serving_model_version gauge",
+        ]
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            lbl = f'model="{e.name}"'
+            lines.append(
+                f"mxnet_serving_model_requests_total{{{lbl}}} {e.requests}")
+            lines.append(
+                f"mxnet_serving_model_canary_routed_total{{{lbl}}} "
+                f"{e.canary_routed}")
+            lines.append(
+                f"mxnet_serving_model_shadow_errors_total{{{lbl}}} "
+                f"{e.shadow_errors}")
+            lines.append(
+                f'mxnet_serving_model_version{{{lbl},track="primary"}} '
+                f"{e.primary.version}")
+            if e.canary is not None:
+                lines.append(
+                    f'mxnet_serving_model_version{{{lbl},track="canary"}} '
+                    f"{e.canary.version}")
+        return "\n".join(lines) + "\n"

@@ -59,19 +59,25 @@ _PROBE_BACKOFF_CAP = 10.0
 
 
 class Replica:
-    """One model replica: per-bucket predictors bound to one device, a
-    lock serializing forwards against weight swaps, and a single-thread
-    executor so a hung device call can be timed out (and later probes
-    queue behind it — a wedged replica stays observably wedged instead of
-    stacking threads onto a dead device)."""
+    """One model replica: per-bucket predictors bound to one device — or,
+    with a serving mesh spec (``MXNET_SERVING_MESH``), to one device
+    GROUP (``mesh`` is the replica's :class:`GraftMesh` sub-mesh and the
+    predictors are tp/pp-sharded over it) — a lock serializing forwards
+    against weight swaps, and a single-thread executor so a hung device
+    call can be timed out (and later probes queue behind it — a wedged
+    replica stays observably wedged instead of stacking threads onto a
+    dead device). The pool's health machinery is mesh-agnostic: a group
+    replica opens/probes/ejects exactly like a one-device replica."""
 
     __slots__ = ("rid", "ctx", "predictors", "lock", "version", "state",
                  "consec", "backoff", "open_at", "probing", "in_flight",
-                 "batches", "failures", "last_error", "_exec", "_seq")
+                 "batches", "failures", "last_error", "_exec", "_seq",
+                 "mesh")
 
-    def __init__(self, rid, ctx, predictors):
+    def __init__(self, rid, ctx, predictors, mesh=None):
         self.rid = int(rid)
         self.ctx = ctx
+        self.mesh = mesh  # GraftMesh device group, None = single device
         self.predictors = dict(predictors)
         # serializes this replica's forwards against per-replica weight
         # swaps (ModelServer.reload): every batch computes against exactly
@@ -108,6 +114,10 @@ class Replica:
 
     def device(self):
         try:
+            if self.mesh is not None:
+                devs = ",".join(
+                    str(d) for d in self.mesh.mesh.devices.flat)
+                return f"{self.mesh.spec}[{devs}]"
             return str(self.ctx.jax_device())
         except Exception:  # noqa: BLE001 — stats must never raise
             return repr(self.ctx)

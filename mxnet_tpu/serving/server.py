@@ -94,7 +94,23 @@ class ServingConfig:
     replicas : int
         Model replicas, one per device. 0 (default) = auto: every local
         accelerator device on TPU, 1 on CPU (today's single-device
-        behavior). Clamped to the devices actually present.
+        behavior). Clamped to the devices actually present. With a
+        ``mesh`` spec it instead caps how many device GROUPS serve.
+    mesh : str
+        Per-replica device-group spec (``MXNET_SERVING_MESH``):
+        ``"auto"`` keeps one-device replicas; ``"tp2"`` partitions the
+        local devices into 2-device tensor-parallel groups (8 devices →
+        4 group-replicas), ``"pp4"`` into 4-stage GPipe groups, etc.
+        Every replica hosts per-bucket SHARDED predictors over its group.
+    seq_buckets : sequence of int, str, or empty
+        Sequence-length buckets (``MXNET_SERVING_SEQ_BUCKETS``). Empty =
+        fixed-shape serving. Non-empty adds a second bucketing axis:
+        requests pad to (batch, seq) buckets and the server needs a
+        ``sym_gen`` callable (BucketingModule-style) producing the
+        per-seq-len symbol.
+    seq_axis : int
+        Per-SAMPLE axis carrying the variable sequence length
+        (``MXNET_SERVING_SEQ_AXIS``).
     replica_timeout_ms : float
         Per-batch execution watchdog: a replica call exceeding this is
         abandoned (breaker OPEN, ``serving.replica.timeout``) and the
@@ -121,13 +137,15 @@ class ServingConfig:
     __slots__ = ("buckets", "max_delay", "queue_depth", "deadline",
                  "watch_dir", "watch_period", "fold_bn", "replicas",
                  "replica_timeout", "max_retries", "hedge", "cb_errors",
-                 "cb_probe", "cb_slow", "max_body_bytes")
+                 "cb_probe", "cb_slow", "max_body_bytes", "mesh",
+                 "seq_buckets", "seq_axis")
 
     def __init__(self, buckets=None, max_delay_ms=None, queue_depth=None,
                  deadline_ms=None, watch_dir=None, watch_period=None,
                  fold_bn=True, replicas=None, replica_timeout_ms=None,
                  max_retries=None, hedge_ms=None, cb_errors=None,
-                 cb_probe_ms=None, cb_slow_ms=None, max_body_bytes=None):
+                 cb_probe_ms=None, cb_slow_ms=None, max_body_bytes=None,
+                 mesh=None, seq_buckets=None, seq_axis=None):
         if buckets is None:
             buckets = _env.get("MXNET_SERVING_BUCKETS")
         if isinstance(buckets, str):
@@ -169,6 +187,22 @@ class ServingConfig:
         if max_body_bytes is None:
             max_body_bytes = _env.get("MXNET_SERVING_MAX_BODY_BYTES")
         self.max_body_bytes = max(0, int(max_body_bytes))
+        if mesh is None:
+            mesh = _env.get("MXNET_SERVING_MESH")
+        self.mesh = str(mesh or "auto").strip() or "auto"
+        if seq_buckets is None:
+            seq_buckets = _env.get("MXNET_SERVING_SEQ_BUCKETS")
+        if isinstance(seq_buckets, str):
+            self.seq_buckets = (_parse_buckets(seq_buckets)
+                                if seq_buckets.strip() else ())
+        elif seq_buckets:
+            self.seq_buckets = _parse_buckets(
+                ",".join(map(str, seq_buckets)))
+        else:
+            self.seq_buckets = ()
+        if seq_axis is None:
+            seq_axis = _env.get("MXNET_SERVING_SEQ_AXIS")
+        self.seq_axis = max(0, int(seq_axis))
 
 
 def _load_params(source):
@@ -233,15 +267,31 @@ class ModelServer:
     """
 
     def __init__(self, symbol, params, input_shapes, config=None, ctx=None,
-                 dev_type="cpu", dev_id=0, input_types=None, logger=None):
-        from ..predictor import Predictor
+                 dev_type="cpu", dev_id=0, input_types=None, logger=None,
+                 sym_gen=None):
         from ..symbol import Symbol, fromjson, load as sym_load
 
         from ..context import Context
 
         self.config = config or ServingConfig()
         self.logger = logger or logging.getLogger("mxnet_tpu.serving")
-        if isinstance(symbol, Symbol):
+        self._sym_gen = sym_gen
+        if sym_gen is not None:
+            # BucketingModule-style sequence serving: the symbol varies
+            # per seq-len bucket; fold_bn is skipped (folding per-bucket
+            # graphs against one shared weight set is out of scope)
+            if not self.config.seq_buckets:
+                raise MXNetError(
+                    "sym_gen given but no seq buckets configured "
+                    "(MXNET_SERVING_SEQ_BUCKETS / ServingConfig"
+                    "(seq_buckets=...))")
+            sym = None
+        elif self.config.seq_buckets:
+            raise MXNetError(
+                "seq buckets configured but no sym_gen given: "
+                "variable-length serving needs the per-seq-len symbol "
+                "factory (BucketingModule's sym_gen contract)")
+        elif isinstance(symbol, Symbol):
             sym = symbol
         elif isinstance(symbol, str) and symbol.lstrip().startswith("{"):
             sym = fromjson(symbol)
@@ -249,30 +299,55 @@ class ModelServer:
             sym = sym_load(symbol)
         arg_params, aux_params, loaded_commit = _load_params(params)
         self._orig_symbol = sym  # reload must re-fold from the raw graph
-        self._symbol, arg_params, aux_params = self._fold(
-            sym, arg_params, aux_params)
+        if sym is None:
+            self._fold_active = False
+            self._symbol = None
+        else:
+            self._symbol, arg_params, aux_params = self._fold(
+                sym, arg_params, aux_params)
         self._sample_shapes = {k: tuple(v) for k, v in input_shapes.items()}
         self._input_names = tuple(self._sample_shapes)
         self._input_types = dict(input_types or {})
         self._ctx = ctx or Context(dev_type, dev_id)
+        # one symbol per seq bucket, generated once (every replica and
+        # batch bucket shares the same per-seq graph)
+        self._seq_syms = {s: self._gen_symbol(s)
+                          for s in self.config.seq_buckets}
 
         replicas = []
-        for rid, rctx in enumerate(self._replica_contexts()):
-            # move weights to EACH replica's device once: that replica's
-            # bucket predictors then all bind the same device-resident
-            # arrays (as_in_context is a no-op in-context) — one HBM copy
-            # and one host→device transfer per replica, not per bucket
-            r_args = self._to_ctx(arg_params, rctx)
-            r_aux = self._to_ctx(aux_params, rctx)
-            preds = {}
-            for b in self.config.buckets:
-                shapes = {n: (b,) + s
-                          for n, s in self._sample_shapes.items()}
-                preds[b] = Predictor(
-                    self._symbol, self._combined(r_args, r_aux),
-                    shapes, ctx=rctx,
-                    fold_bn=False, input_types=self._input_types or None)
-            replicas.append(Replica(rid, rctx, preds))
+        groups = self._device_groups()
+        if groups is None:
+            for rid, rctx in enumerate(self._replica_contexts()):
+                # move weights to EACH replica's device once: that
+                # replica's bucket predictors then all bind the same
+                # device-resident arrays (as_in_context is a no-op
+                # in-context) — one HBM copy and one host→device
+                # transfer per replica, not per bucket
+                r_args = self._to_ctx(arg_params, rctx)
+                r_aux = self._to_ctx(aux_params, rctx)
+                preds = {key: self._make_predictor(bsym, shapes, rctx,
+                                                   r_args, r_aux, None)
+                         for key, bsym, shapes in self._bucket_items()}
+                replicas.append(Replica(rid, rctx, preds))
+        else:
+            # mesh-native serving: each replica owns a GraftMesh device
+            # GROUP and hosts per-bucket SHARDED predictors over it (tp
+            # via __shard__ NamedShardings, pp via the GPipe engine in
+            # inference-only mode); the pool's health machinery treats a
+            # group exactly like a single device
+            for rid, g in enumerate(groups):
+                rctx = Context(self._ctx.device_type,
+                               g.mesh.devices.flat[0].id)
+                r_args = self._to_ctx(arg_params, rctx)
+                r_aux = self._to_ctx(aux_params, rctx)
+                preds = {key: self._make_predictor(bsym, shapes, rctx,
+                                                   r_args, r_aux, g)
+                         for key, bsym, shapes in self._bucket_items()}
+                replicas.append(Replica(rid, rctx, preds, mesh=g))
+            self.logger.info(
+                "serving: %d group-replica(s) of %r over %d device(s)",
+                len(replicas), self.config.mesh,
+                sum(g.mesh.devices.size for g in groups))
         self._pool = ReplicaPool(
             replicas,
             timeout=self.config.replica_timeout,
@@ -285,15 +360,10 @@ class ModelServer:
         # replica 0's predictors, for benchmarks/tests that drive a
         # bucket program directly (srv.predictor(b))
         self._predictors = replicas[0].predictors
-        from ..base import np_dtype
-
-        p1 = self._predictors[self.config.buckets[0]]
-        # np_dtype, not np.dtype(str(...)): 'bfloat16' is a framework
-        # dtype that numpy's parser does not know
-        self._input_dtypes = {
-            n: np_dtype(p1._exec.arg_dict[n].dtype)
-            for n in self._input_names
-        }
+        # predictors expose their bound dtypes (np_dtype under the hood:
+        # 'bfloat16' is a framework dtype numpy's parser does not know)
+        p1 = self._predictors[next(iter(self._predictors))]
+        self._input_dtypes = p1.input_dtypes()
         self.latency = LatencyHistogram()
         self._batcher = DynamicBatcher(
             self._infer, self.config.buckets,
@@ -321,6 +391,71 @@ class ModelServer:
             loaded_commit if self._is_watch_dir(params) else None)
 
     # -- construction helpers ------------------------------------------
+    def _device_groups(self):
+        """Partition the local devices into per-replica GraftMesh groups
+        from ``config.mesh`` (None when the spec is ``auto`` — classic
+        one-device replicas). ``config.replicas`` caps the group count;
+        leftover devices that don't fill a group are unused."""
+        spec = self.config.mesh
+        if spec.lower() in ("", "auto"):
+            return None
+        import jax
+
+        from .sharded import partition_devices
+
+        if self._ctx.device_type in ("cpu", "cpu_pinned"):
+            devices = jax.devices("cpu")
+        else:
+            devices = jax.devices()
+        groups = partition_devices(spec, devices)
+        if self.config.replicas > 0:
+            groups = groups[:self.config.replicas]
+        return groups
+
+    def _gen_symbol(self, seq_len):
+        sym = self._sym_gen(seq_len)
+        if isinstance(sym, tuple):  # (symbol, data_names, label_names)
+            sym = sym[0]
+        return sym
+
+    def _seq_shape(self, sample_shape, seq_len):
+        shape = list(sample_shape)
+        shape[self.config.seq_axis] = seq_len
+        return tuple(shape)
+
+    def _bucket_items(self):
+        """Yield ``(predictor key, symbol, batched input shapes)`` per
+        compiled program: plain batch buckets, or (batch, seq) composite
+        keys when seq bucketing is on — the complete program universe one
+        replica hosts (and warmup compiles)."""
+        for b in self.config.buckets:
+            if self.config.seq_buckets:
+                for s in self.config.seq_buckets:
+                    shapes = {n: (b,) + self._seq_shape(shape, s)
+                              for n, shape in self._sample_shapes.items()}
+                    yield (b, s), self._seq_syms[s], shapes
+            else:
+                shapes = {n: (b,) + shape
+                          for n, shape in self._sample_shapes.items()}
+                yield b, self._symbol, shapes
+
+    def _make_predictor(self, sym, shapes, rctx, r_args, r_aux, group):
+        """One bucket program: a plain Predictor (single device), a
+        mesh-sharded Predictor (tp/dp group), or a PipelinePredictor
+        (group spec with a pp axis — GPipe inference scheduling)."""
+        from ..predictor import Predictor
+
+        params = self._combined(r_args, r_aux)
+        if group is not None and group.has("pp") and group.pp > 1:
+            from .sharded import PipelinePredictor
+
+            return PipelinePredictor(
+                sym, params, shapes, mesh=group, ctx=rctx,
+                input_types=self._input_types or None, logger=self.logger)
+        return Predictor(
+            sym, params, shapes, ctx=rctx, fold_bn=False,
+            input_types=self._input_types or None, mesh=group)
+
     def _replica_contexts(self):
         """One Context per replica. ``config.replicas == 0`` is auto: all
         local accelerator devices on TPU, 1 on CPU (the single-device
@@ -427,14 +562,14 @@ class ModelServer:
                 with ThreadPoolExecutor(
                         max_workers=min(len(items),
                                         os.cpu_count() or 1)) as pool:
-                    futs = {(rid, b): pool.submit(pred._exec.compile,
-                                                  ["forward"])
+                    futs = {(rid, b): pool.submit(pred.compile,
+                                                  ("forward",))
                             for rid, b, pred in items}
                     for (rid, b), f in futs.items():
                         done[rid][b] = f.result()
             else:
                 for rid, b, pred in items:
-                    done[rid][b] = pred._exec.compile(["forward"])
+                    done[rid][b] = pred.compile(("forward",))
         self._warm = True
         _tm.counter("serving.warmup_buckets").inc(len(items))
         self.logger.info(
@@ -486,18 +621,48 @@ class ModelServer:
     def _coerce(self, inputs):
         """Validate one request's inputs against the per-sample contract
         and coerce to the BOUND dtypes (so stacking/padding is exact and
-        integer inputs stay integers)."""
+        integer inputs stay integers). Returns ``(coerced, group)`` —
+        with seq bucketing on, the variable seq axis is zero-padded up to
+        its seq-len bucket and ``group`` is that bucket (the batcher's
+        second bucketing axis); otherwise ``group`` is None."""
         if not isinstance(inputs, dict):
             if len(self._input_names) != 1:
                 raise MXNetError(
                     f"model has inputs {self._input_names}; pass a dict")
             inputs = {self._input_names[0]: inputs}
+        seq_buckets = self.config.seq_buckets
+        axis = self.config.seq_axis
+        group = seq_len = None
         out = {}
         for name, shape in self._sample_shapes.items():
             if name not in inputs:
                 raise MXNetError(f"missing input {name!r}")
             arr = np.asarray(inputs[name])  # graftlint: allow=host-sync(coerces the client payload, which is host data by definition; no device handle reaches admission)
-            if tuple(arr.shape) != shape:
+            if seq_buckets:
+                if arr.ndim != len(shape):
+                    raise MXNetError(
+                        f"input {name!r}: rank {len(shape)} expected, "
+                        f"got {arr.ndim}")
+                if seq_len is None:
+                    # the FIRST input fixes the request's seq length;
+                    # every other input must agree (one shared bucket)
+                    seq_len = int(arr.shape[axis])
+                    if not 1 <= seq_len <= seq_buckets[-1]:
+                        raise MXNetError(
+                            f"input {name!r}: seq length {seq_len} not "
+                            f"served (seq buckets "
+                            f"{list(seq_buckets)})")
+                    group = next(s for s in seq_buckets if s >= seq_len)
+                expect = self._seq_shape(shape, seq_len)
+                if tuple(arr.shape) != expect:
+                    raise MXNetError(
+                        f"input {name!r}: per-sample shape {expect} "
+                        f"expected, got {tuple(arr.shape)}")
+                if seq_len < group:
+                    pad = [(0, 0)] * arr.ndim
+                    pad[axis] = (0, group - seq_len)
+                    arr = np.pad(arr, pad)
+            elif tuple(arr.shape) != shape:
                 raise MXNetError(
                     f"input {name!r}: per-sample shape {shape} expected, "
                     f"got {tuple(arr.shape)}")
@@ -506,21 +671,23 @@ class ModelServer:
         unknown = set(inputs) - set(self._sample_shapes)
         if unknown:
             raise MXNetError(f"unknown inputs {sorted(unknown)}")
-        return out
+        return out, group
 
     def submit(self, inputs, deadline_ms=None):
         """Admit one request; returns a ``Future`` resolving to the list
-        of output arrays (one per model output, per-sample shape).
+        of output arrays (one per model output, per-sample shape; with
+        seq bucketing the seq axis comes back padded to its bucket).
         Sheds with ``ServerOverloaded`` when the (capacity-scaled) queue
         is full, ``NoHealthyReplicas`` when the whole pool is down."""
         if self._closed:
             raise ServerClosed("server closed")
-        coerced = self._coerce(inputs)
+        coerced, group = self._coerce(inputs)
         if deadline_ms is None and self.config.deadline > 0:
             deadline_ms = self.config.deadline * 1e3
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms else None)
-        return self._batcher.submit(coerced, deadline=deadline)
+        return self._batcher.submit(coerced, deadline=deadline,
+                                    group=group)
 
     def predict(self, inputs, timeout=None, deadline_ms=None):
         """Synchronous :meth:`submit` — blocks for the outputs."""
@@ -618,22 +785,34 @@ class ModelServer:
                 f"replica {rep.rid} lock not acquired in "
                 f"{lock_timeout:.0f} s (hung forward?)")
         try:
-            # every bucket binds the SAME device arrays (weights were
-            # moved to this replica's ctx once at construction, pinned by
-            # test_buckets_share_device_weights), so one set_params swaps
-            # the values every bucket sees; the other buckets only need
-            # their param STORES synced for a later reshape re-bind
-            first, *rest = rep.predictors.values()
-            first.set_params(r_args, r_aux, allow_missing=False)
-            for pred in rest:
-                with pred._lock:
-                    for name in r_args:
-                        if name in first.arg_params:
-                            pred.arg_params[name] = first.arg_params[name]
-                    for name in r_aux:
-                        if name in first.aux_params:
-                            pred.aux_params[name] = first.aux_params[name]
-                    pred._partial_outs = None
+            if rep.mesh is not None:
+                # group replicas: sharded predictors re-wrap their bound
+                # params in fresh mesh-placed arrays at bind time, so no
+                # device arrays are shared across buckets — every bucket
+                # program swaps its own copy (still under this replica's
+                # lock, so the swap lands between this replica's batches)
+                for pred in rep.predictors.values():
+                    pred.set_params(r_args, r_aux, allow_missing=False)
+            else:
+                # every bucket binds the SAME device arrays (weights were
+                # moved to this replica's ctx once at construction, pinned
+                # by test_buckets_share_device_weights), so one set_params
+                # swaps the values every bucket sees; the other buckets
+                # only need their param STORES synced for a later reshape
+                # re-bind
+                first, *rest = rep.predictors.values()
+                first.set_params(r_args, r_aux, allow_missing=False)
+                for pred in rest:
+                    with pred._lock:
+                        for name in r_args:
+                            if name in first.arg_params:
+                                pred.arg_params[name] = \
+                                    first.arg_params[name]
+                        for name in r_aux:
+                            if name in first.aux_params:
+                                pred.aux_params[name] = \
+                                    first.aux_params[name]
+                        pred._partial_outs = None
             rep.version = new_version
         finally:
             rep.lock.release()

@@ -1,0 +1,328 @@
+"""Sharded serving: mesh partitioning and the pipeline-parallel predictor.
+
+The serving pool treats a *device group* as one replica
+(``MXNET_SERVING_MESH``): :func:`partition_devices` carves the local
+devices into contiguous ``GraftMesh`` sub-meshes of one spec, and each
+group hosts per-bucket sharded predictors —
+
+- **tp** specs reuse the plain :class:`~mxnet_tpu.predictor.Predictor`
+  with ``mesh=``: ``__shard__`` NamedShardings on the params, batch
+  replicated across the group (no dp axis inside a serving group).
+- **pp** specs run the GPipe engine forward-only through
+  :class:`PipelinePredictor`: the serving symbol is auto-split into
+  ``pp`` chain stages (:func:`split_symbol_chain`), bound through
+  ``SequentialModule`` under the group mesh, with the engine's inference
+  param cache on so the request path is one program dispatch.
+
+Both keep the serving invariant: every (bucket) program is compiled at
+warmup, the request path never compiles.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+from ..context import cpu
+
+__all__ = [
+    "partition_devices", "split_symbol_chain", "PipelinePredictor",
+]
+
+
+def partition_devices(spec, devices):
+    """Partition ``devices`` into contiguous per-replica ``GraftMesh``
+    groups of layout ``spec`` (e.g. ``"tp2"`` on 8 devices → 4 two-device
+    tp meshes). Wildcard axes are resolved against the FULL device list
+    (``"pp*"`` = one group spanning everything). Leftover devices that
+    don't fill a complete group are dropped with the caller expected to
+    warn (a partial group cannot run the sharded program)."""
+    from ..parallel.mesh import GraftMesh, parse_mesh_spec
+
+    axis_sizes = parse_mesh_spec(spec, devices=devices)
+    group = int(np.prod(list(axis_sizes.values()))) if axis_sizes else 1
+    if group < 1 or group > len(devices):
+        raise MXNetError(
+            f"serving mesh spec {spec!r} needs {group} devices per "
+            f"replica but only {len(devices)} are visible")
+    meshes = []
+    for start in range(0, len(devices) - group + 1, group):
+        meshes.append(GraftMesh.from_axes(
+            axis_sizes, devices=devices[start:start + group]))
+    return meshes
+
+
+def _find_cuts(symbol):
+    """Valid chain-cut op nodes of a single-head symbol, in topo order.
+
+    A cut after op node ``c`` is valid when every edge crossing the
+    boundary is either a variable (params flow to their own stage) or
+    ``c``'s output 0 — i.e. the suffix consumes exactly one activation.
+    """
+    topo = symbol._topo()
+    pos = {id(n): i for i, n in enumerate(topo)}
+    head = symbol._outputs[0][0]
+    cuts = []
+    for c in topo:
+        if c.is_variable or c is head:
+            continue
+        pc = pos[id(c)]
+        ok = True
+        for v in topo:
+            if v.is_variable or pos[id(v)] <= pc:
+                continue
+            for u, k in v.inputs:
+                if (pos[id(u)] <= pc and not u.is_variable
+                        and not (u is c and k == 0)):
+                    ok = False
+                    break
+            if not ok:
+                break
+        if ok:
+            cuts.append(c)
+    return cuts
+
+
+def split_symbol_chain(symbol, num_stages):
+    """Auto-split a single-output symbol into ``num_stages`` chain stages
+    for pipeline serving.
+
+    Returns ``[(stage_symbol, input_name), ...]`` where ``input_name`` is
+    None for the first stage (it keeps the original data inputs) and the
+    boundary activation's variable name for the rest. Stage boundaries
+    are the valid single-activation cuts closest to an even op-count
+    split; variables (params, aux) are SHARED between the original and
+    the stage symbols, op nodes downstream of a cut are cloned with the
+    cut activation replaced by a fresh input variable.
+    """
+    from ..symbol import Symbol, Variable, _Node
+
+    if num_stages <= 1:
+        return [(symbol, None)]
+    if len(symbol._outputs) != 1:
+        raise MXNetError(
+            "pipeline serving requires a single-output symbol "
+            f"(got {len(symbol._outputs)} heads)")
+    topo = symbol._topo()
+    pos = {id(n): i for i, n in enumerate(topo)}
+    ops = [n for n in topo if not n.is_variable]
+    cuts = _find_cuts(symbol)
+    if len(cuts) < num_stages - 1:
+        raise MXNetError(
+            f"cannot split symbol into {num_stages} pipeline stages: only "
+            f"{len(cuts)} single-activation cut points in a graph of "
+            f"{len(ops)} ops")
+    # pick the S-1 distinct cuts nearest an even op-count split
+    op_index = {id(n): i for i, n in enumerate(ops)}
+    chosen = []
+    for j in range(1, num_stages):
+        target = j * len(ops) / num_stages
+        best = min((c for c in cuts if c not in chosen),
+                   key=lambda c: abs(op_index[id(c)] - target))
+        chosen.append(best)
+    chosen.sort(key=lambda c: pos[id(c)])
+    if len(set(id(c) for c in chosen)) != num_stages - 1:
+        raise MXNetError(
+            f"cannot place {num_stages - 1} distinct pipeline cuts "
+            f"(graph has {len(cuts)} candidates, too clustered)")
+
+    stages = []
+    prev_cut = None  # original cut node the current stage starts after
+    for j in range(num_stages):
+        upper = chosen[j] if j < num_stages - 1 else None
+        if j == 0:
+            # first stage shares the original prefix nodes outright
+            stages.append((Symbol([(upper, 0)]), None))
+            prev_cut = upper
+            continue
+        in_name = f"{prev_cut.name}_output"
+        boundary = Variable(in_name)._outputs[0][0]
+        memo = {}
+
+        def conv(n, _prev=prev_cut, _boundary=boundary, _memo=memo):
+            if n is _prev:
+                return _boundary
+            if n.is_variable:
+                return n  # share param/aux variable nodes
+            got = _memo.get(id(n))
+            if got is None:
+                got = _Node(n.op, n.name, dict(n.attrs),
+                            [(conv(u), k) for u, k in n.inputs], n.is_aux)
+                _memo[id(n)] = got
+            return got
+
+        if upper is not None:
+            heads = [(conv(upper), 0)]
+        else:
+            heads = [(conv(h), i) for h, i in symbol._outputs]
+        stages.append((Symbol(heads), in_name))
+        prev_cut = upper
+    return stages
+
+
+class PipelinePredictor:
+    """Predictor-shaped wrapper running inference through the GPipe engine.
+
+    Mirrors the :class:`~mxnet_tpu.predictor.Predictor` surface the
+    serving stack drives — ``run``/``set_params``/``compile``/
+    ``input_dtypes`` under one re-entrant lock — while executing as an
+    inference-only pipelined program over a ``pp`` (optionally
+    ``tp×pp``) group mesh. Stage modules come from
+    :func:`split_symbol_chain`; microbatch count is the largest divisor
+    of the bucket's batch size ≤ the pp degree, so every bucket down to
+    batch 1 schedules (bubble-heavy at the tiny end, amortized at the
+    assembled-batch end).
+    """
+
+    def __init__(self, symbol, param_source, input_shapes, mesh,
+                 ctx=None, input_types=None, logger=None):
+        import logging
+
+        from ..module.module import Module
+        from ..module.sequential_module import SequentialModule
+        from ..parallel.mesh import as_graft, with_mesh
+
+        self._lock = threading.RLock()
+        self._mesh = as_graft(mesh)
+        self.ctx = ctx if ctx is not None else cpu()
+        self.symbol = symbol
+        self.input_shapes = dict(input_shapes)
+        if len(self.input_shapes) != 1:
+            raise MXNetError(
+                "pipeline serving supports exactly one data input "
+                f"(got {sorted(self.input_shapes)})")
+        self.input_types = {
+            k: np_dtype(v) for k, v in (input_types or {}).items()
+        }
+        self.arg_params, self.aux_params = _split_params(param_source)
+        # names that came from the weight file, before zero-fill below:
+        # set_params' half-swap guard applies to these only (a reload is
+        # not required to re-supply labels/zero-filled placeholders)
+        self._file_args = frozenset(self.arg_params)
+
+        (self._data_name, shape), = self.input_shapes.items()
+        batch = int(shape[0])
+        micro = next(m for m in range(self._mesh.pp, 0, -1)
+                     if batch % m == 0)
+        stages = split_symbol_chain(symbol, self._mesh.pp)
+        # zero-fill args/aux absent from the param file (labels bound as
+        # params, etc.) — the c_predict_api convention Predictor keeps;
+        # shapes thread stage to stage through the boundary activation
+        from ..ndarray import zeros as nd_zeros
+
+        flow = tuple(shape)
+        for ssym, in_name in stages:
+            name = in_name or self._data_name
+            arg_shapes, out_shapes, aux_shapes = ssym.infer_shape(
+                **{name: flow})
+            for n, s in zip(ssym.list_arguments(), arg_shapes):
+                if n != name and n not in self.arg_params:
+                    self.arg_params[n] = nd_zeros(s, ctx=self.ctx)
+            for n, s in zip(ssym.list_auxiliary_states(), aux_shapes):
+                if n not in self.aux_params:
+                    self.aux_params[n] = nd_zeros(s, ctx=self.ctx)
+            flow = tuple(out_shapes[0])
+        self._seq = SequentialModule(
+            logger=logger or logging, pipeline_microbatches=micro)
+        for ssym, in_name in stages:
+            self._seq.add(
+                Module(ssym, data_names=(in_name or self._data_name,),
+                       label_names=(), context=self.ctx,
+                       logger=logger or logging),
+                take_labels=False, auto_wiring=True)
+        with with_mesh(self._mesh):
+            self._seq.bind(data_shapes=[(self._data_name, tuple(shape))],
+                           label_shapes=None, for_training=False)
+            # every bound name resolves from the (zero-filled) param dicts;
+            # the initializer is never consulted
+            self._seq.init_params(arg_params=self.arg_params,
+                                  aux_params=self.aux_params,
+                                  allow_missing=True)
+        self._engine = self._seq._pp_engine
+        if self._engine is None:
+            raise MXNetError(
+                f"serving mesh {self._mesh.spec!r} has no pp axis; "
+                "PipelinePredictor requires one")
+        # request path = one program dispatch: params stay packed/stacked
+        # between batches (set_params invalidates)
+        self._engine.cache_inference_params = True
+
+    def input_dtypes(self):
+        with self._lock:
+            exe = self._seq._stages[0].module._exec_group._exec
+            return {self._data_name:
+                    np_dtype(exe.arg_dict[self._data_name].dtype)}
+
+    def run(self, **inputs):
+        """Atomic pipelined forward; numpy outputs (Predictor contract)."""
+        from ..io import DataBatch
+        from ..ndarray import array
+        from ..parallel.mesh import with_mesh
+
+        with self._lock:
+            if set(inputs) != {self._data_name}:
+                raise MXNetError(
+                    f"pipeline predictor takes exactly {self._data_name!r} "
+                    f"(got {sorted(inputs)})")
+            data = inputs[self._data_name]
+            arr = array(np.asarray(data),
+                        dtype=self.input_types.get(self._data_name))
+            batch = DataBatch(data=[arr])
+            with with_mesh(self._mesh):
+                outs = self._engine.run(batch, is_train=False)
+            return [o.asnumpy() for o in outs]
+
+    def compile(self, kinds=("forward",)):
+        """Warm every program on the request path: one zeros batch builds
+        the engine's inference program AND primes the param cache, so
+        live batches are a single cached dispatch."""
+        shape = self.input_shapes[self._data_name]
+        dt = self.input_types.get(self._data_name, np.float32)
+        self.run(**{self._data_name: np.zeros(shape, dt)})
+        return ["forward"]
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False):
+        """Hot-swap weights across all stage modules (values only; shapes
+        must match), then invalidate the engine's packed-param cache so
+        the next batch computes against the new set."""
+        aux_params = dict(aux_params or {})
+        arg_params = dict(arg_params)
+        with self._lock:
+            bound_args, bound_auxs = self._seq.get_params()
+            missing = [n for n in bound_args
+                       if n not in arg_params and n in self._file_args]
+            if missing and not allow_missing:
+                raise MXNetError(
+                    f"set_params: missing {len(missing)} bound params "
+                    f"(e.g. {missing[:3]}); pass allow_missing=True to "
+                    "keep current values for them")
+            unknown = [n for n in arg_params if n not in bound_args]
+            if unknown:
+                raise MXNetError(
+                    f"set_params: {unknown[0]!r} is not a bound argument")
+            for m in self._seq._children():
+                a, x = m.get_params()
+                m.set_params(
+                    {k: arg_params.get(k, v) for k, v in a.items()},
+                    {k: aux_params.get(k, v) for k, v in x.items()},
+                    allow_missing=False, force_init=True)
+            self.arg_params.update(arg_params)
+            self.aux_params.update(
+                {k: v for k, v in aux_params.items() if k in bound_auxs})
+            self._engine.invalidate_params()
+
+
+def _split_params(param_source):
+    """Predictor-style param split: ``arg:``/``aux:`` prefixed keys (or
+    bare = arg) from a dict of NDArrays."""
+    arg_params, aux_params = {}, {}
+    for k, v in dict(param_source).items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params

@@ -178,6 +178,49 @@ def test_bench_serve_mode_beats_sequential_and_never_compiles():
         f"{rec['sequential_img_per_sec']} img/s")
 
 
+def test_bench_serve_sharded_legs_no_compile_and_curve():
+    """BENCH_SERVE_SHARDED=1 on the virtual 8-device CPU mesh: every
+    mesh leg (tp2 / pp2 / dp-of-tp2) serves with ZERO request-path
+    compiles and zero errors, dp-of-tp2 actually fans out to 4 group
+    replicas, and the tp2 scaling curve is reported at 1/2/4 groups.
+    (The curve's SLOPE is the TPU round's acceptance — virtual CPU
+    devices share host cores, so only structure is pinned here.)"""
+    env = dict(os.environ)
+    clean = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([_ROOT] + clean)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    env["BENCH_MODE"] = "serve"
+    env["BENCH_LAYERS"] = "18"
+    env["BENCH_SERVE_CLIENTS"] = "4"
+    env["BENCH_SERVE_REQUESTS"] = "6"
+    env["BENCH_SERVE_SEQ_ITERS"] = "2"
+    env["BENCH_SERVE_SCALING"] = "0"
+    env["BENCH_SERVE_SHARDED"] = "1"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py")],
+        capture_output=True, text=True, env=env, timeout=900, cwd=_ROOT,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    sharded = rec["sharded"]
+    for name in ("tp2", "pp2", "dp-tp2"):
+        leg = sharded[name]
+        assert leg["errors"] == 0, (name, leg)
+        assert leg["request_path_compiles"] == 0, (name, leg)
+        assert leg["img_per_sec"] > 0, (name, leg)
+        assert leg["p99_ms"] > 0, (name, leg)
+    assert sharded["tp2"]["replicas"] == 1
+    assert sharded["pp2"]["replicas"] == 1
+    assert sharded["dp-tp2"]["replicas"] == 4
+    curve = sharded["tp2_scaling_curve"]
+    assert sorted(curve) == ["1", "2", "4"]
+    assert all(v > 0 for v in curve.values()), curve
+    assert sharded["group_scaling_4x"] > 0
+
+
 def test_bench_serve_chaos_availability():
     """BENCH_CHAOS=1 serve leg: a replica killed under concurrent traffic
     and later revived must cost availability NOTHING (failover absorbs

@@ -361,3 +361,93 @@ def test_cached_op_tier(tmp_path):
     assert L.MXNDArrayFree(out_h) == 0
     assert L.MXSymbolFree(var) == 0
     assert L.MXSymbolFree(sym) == 0
+
+
+def test_atomic_symbol_info_and_recordio_cursor(amalgamated, tmp_path):
+    """ROADMAP 5b slice: MXSymbolGetAtomicSymbolInfo (op parameter schema
+    — the tier binding generators sit on) and the RecordIO byte cursor
+    (MXRecordIOWriterTell / MXRecordIOReaderSeek — what .idx sidecars
+    store), round-tripped through the amalgamated C library."""
+    import ctypes
+
+    L = ctypes.CDLL(os.path.join(amalgamated, "libmxtpu.so"))
+    L.MXGetLastError.restype = ctypes.c_char_p
+
+    n = ctypes.c_uint32()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    assert L.MXSymbolListAtomicSymbolCreators(
+        ctypes.byref(n), ctypes.byref(creators)) == 0
+    name = ctypes.c_char_p()
+    by_name = {}
+    for i in range(n.value):
+        c = ctypes.c_void_p(creators[i])
+        assert L.MXSymbolGetAtomicSymbolName(c, ctypes.byref(name)) == 0
+        by_name[name.value] = c
+
+    desc = ctypes.c_char_p()
+    kv = ctypes.c_char_p()
+    ret = ctypes.c_char_p()
+    n_args = ctypes.c_uint32()
+    anames = ctypes.POINTER(ctypes.c_char_p)()
+    atypes = ctypes.POINTER(ctypes.c_char_p)()
+    adescs = ctypes.POINTER(ctypes.c_char_p)()
+
+    def info(creator):
+        rc = L.MXSymbolGetAtomicSymbolInfo(
+            creator, ctypes.byref(name), ctypes.byref(desc),
+            ctypes.byref(n_args), ctypes.byref(anames),
+            ctypes.byref(atypes), ctypes.byref(adescs),
+            ctypes.byref(kv), ctypes.byref(ret))
+        assert rc == 0, L.MXGetLastError()
+        return {anames[i]: atypes[i] for i in range(n_args.value)}
+
+    # the parameter SCHEMA comes back (dmlc::Parameter fields, not tensor
+    # inputs): names, reference-style type strings, required/default split
+    params = info(by_name[b"FullyConnected"])
+    assert name.value == b"FullyConnected"
+    assert params[b"num_hidden"] == b"int, required"
+    assert params[b"no_bias"] == b"boolean, optional, default=False"
+    assert b"data" not in params and b"weight" not in params
+    assert kv.value == b""
+
+    # variadic ops advertise their key_var_num_args (the field the
+    # reference's wrapper generators key variadic call syntax on)
+    info(by_name[b"Concat"])
+    assert kv.value == b"num_args"
+
+    # error contract: bad creator is -1 + message, never a crash
+    assert L.MXSymbolGetAtomicSymbolInfo(
+        ctypes.c_void_p(10**9), ctypes.byref(name), ctypes.byref(desc),
+        ctypes.byref(n_args), ctypes.byref(anames), ctypes.byref(atypes),
+        ctypes.byref(adescs), ctypes.byref(kv), ctypes.byref(ret)) == -1
+    assert b"AtomicSymbolCreator" in L.MXGetLastError()
+
+    # -- RecordIO cursor: tell on write marks a boundary seek returns to
+    rec = str(tmp_path / "cursor.rec").encode()
+    w = ctypes.c_void_p()
+    assert L.MXRecordIOWriterCreate(rec, ctypes.byref(w)) == 0
+    pos = ctypes.c_size_t()
+    assert L.MXRecordIOWriterTell(w, ctypes.byref(pos)) == 0
+    assert pos.value == 0
+    assert L.MXRecordIOWriterWriteRecord(w, b"first", 5) == 0
+    assert L.MXRecordIOWriterTell(w, ctypes.byref(pos)) == 0
+    second_at = pos.value
+    assert second_at > 0
+    assert L.MXRecordIOWriterWriteRecord(w, b"second-rec", 10) == 0
+    assert L.MXRecordIOWriterFree(w) == 0
+
+    r = ctypes.c_void_p()
+    assert L.MXRecordIOReaderCreate(rec, ctypes.byref(r)) == 0
+    buf = ctypes.c_char_p()
+    sz = ctypes.c_size_t()
+    # skip straight to the second record via the captured offset
+    assert L.MXRecordIOReaderSeek(r, ctypes.c_size_t(second_at)) == 0
+    assert L.MXRecordIOReaderReadRecord(
+        r, ctypes.byref(buf), ctypes.byref(sz)) == 0
+    assert ctypes.string_at(buf, sz.value) == b"second-rec"
+    # rewind to 0: the stream replays from the first record
+    assert L.MXRecordIOReaderSeek(r, ctypes.c_size_t(0)) == 0
+    assert L.MXRecordIOReaderReadRecord(
+        r, ctypes.byref(buf), ctypes.byref(sz)) == 0
+    assert ctypes.string_at(buf, sz.value) == b"first"
+    assert L.MXRecordIOReaderFree(r) == 0

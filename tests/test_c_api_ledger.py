@@ -132,3 +132,38 @@ def test_header_extensions_are_known():
     surprise = declared - ref - known_extensions
     assert not surprise, (
         f"undeclared header extensions: {sorted(surprise)} — ledger them")
+
+
+def test_symbol_info_and_recordio_cursor_slice_is_implemented():
+    """ROADMAP 5b slice: op introspection (MXSymbolGetAtomicSymbolInfo)
+    and the RecordIO byte cursor (WriterTell/ReaderSeek) moved from the
+    out-of-scope bucket into the implemented one — ledgered, declared,
+    and backed by real definitions in c_api.cpp."""
+    slice_ = {"MXSymbolGetAtomicSymbolInfo", "MXRecordIOWriterTell",
+              "MXRecordIOReaderSeek"}
+    impl = set(_read_names("c_api_implemented.txt"))
+    oos = set(_read_names("c_api_out_of_scope.txt"))
+    assert slice_ <= impl, (
+        f"slice not ledgered implemented: {sorted(slice_ - impl)}")
+    assert not (slice_ & oos), "slice still ledgered out-of-scope"
+
+    with open(os.path.join(_NATIVE, "c_api.h")) as f:
+        header = f.read()
+    m = re.search(r"\bMXSymbolGetAtomicSymbolInfo\s*\(([^;]*)\)\s*;", header)
+    assert m, "MXSymbolGetAtomicSymbolInfo not declared in c_api.h"
+    sig = re.sub(r"\s+", " ", m.group(1))
+    # the reference's 9-pointer signature: three string-array outs plus
+    # key_var_num_args/return_type — wrapper generators depend on it
+    assert sig.count("const char***") == 3, sig
+    assert "key_var_num_args" in sig and "return_type" in sig, sig
+    for name, arg in (("MXRecordIOWriterTell", "size_t* pos"),
+                      ("MXRecordIOReaderSeek", "size_t pos")):
+        m = re.search(rf"\b{name}\s*\(([^;]*)\)\s*;", header)
+        assert m, f"{name} not declared in c_api.h"
+        assert arg in re.sub(r"\s+", " ", m.group(1)), m.group(1)
+
+    with open(os.path.join(_NATIVE, "c_api.cpp")) as f:
+        impl_src = f.read()
+    for name in sorted(slice_):
+        assert re.search(rf"\bint {name}\s*\(", impl_src), (
+            f"{name} declared but not defined in c_api.cpp")
