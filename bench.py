@@ -93,6 +93,26 @@ pre/fault/recover phases — pinned >= 0.99 in the cpu smoke),
 ``p99_during_fault_ms``, the failover count, and the killed replica's
 final state (probe-recovered or still open).
 
+``BENCH_MODE=suite`` emits the WHOLE-ZOO scoreboard: every BASELINE
+workload — MLP, LeNet, ResNet-50, bucketed LSTM-PTB, SSD-VGG16, DCGAN —
+through the modern stack (fused K-step train windows, ``BENCH_SUITE_K``;
+pipelined dispatch, ``BENCH_SUITE_DEPTH`` windows in flight), one
+sub-record per workload with train+infer samples/s, analytic
+``gflops_per_sample_fwd`` (models.recipe.estimate_flops; MFU on TPU
+bf16), dtype, window K, dispatch depth and ``steady_compiles`` — the
+compile count over the timed region, pinned 0 by the cpu smoke. The
+DCGAN leg also times the reference imperative loop
+(``legacy_train_samples_per_sec``) so the fused-step win is a recorded
+number, not a claim. ``BENCH_SUITE_WORKLOADS`` subsets by name; the
+headline value is the geomean train rate. See docs/benchmarks.md.
+
+``BENCH_MODE=score`` sweeps forward-only scoring over the 14 zoo symbols
+of the published perf table, sharing the symbol list
+(``models.SCORE_SYMBOLS``) and the scoring loop with
+``examples/benchmark_score.py``. ``BENCH_SCORE_NETS`` subsets,
+``BENCH_SCORE_BATCH`` sizes; per-net records carry samples/s + analytic
+GFLOPs (+ MFU on TPU bf16); the headline is the geomean img/s.
+
 ``BENCH_MODE=ckpt`` times the CHECKPOINT save pause on the training
 thread: two identical fit passes with per-epoch + mid-epoch v2 sharded
 saves — synchronous, then ``MXNET_CKPT_ASYNC``-style async — reporting
@@ -202,21 +222,50 @@ def _maybe_mesh(record, mx):
         record["mesh"] = gm.spec
 
 
-def _maybe_mfu(record, img_per_sec, jax, on_tpu, num_layers, dtype):
-    """Attach model-FLOPs-utilization when the peak is known for this
-    device kind (ResNet-50@224 bf16 only; see the peak table)."""
-    if not (on_tpu and num_layers == 50 and dtype == "bfloat16"):
-        return
-    # MFU note: ResNet-50@224 train ≈ 3x fwd FLOPs ≈ 12.3 GFLOP/img.
-    # Peak is per device kind (bf16); unknown kinds omit the field
-    # rather than report against the wrong denominator.
-    peaks_tflops = {"TPU v5 lite": 197, "TPU v5e": 197,
-                    "TPU v4": 275, "TPU v5p": 459,
-                    "TPU v6 lite": 918, "TPU v6e": 918}
+# bf16 peak per device kind; unknown kinds omit MFU rather than report
+# against the wrong denominator
+_PEAKS_TFLOPS_BF16 = {"TPU v5 lite": 197, "TPU v5e": 197,
+                      "TPU v4": 275, "TPU v5p": 459,
+                      "TPU v6 lite": 918, "TPU v6e": 918}
+
+
+def _peak_tflops(jax):
     kind = getattr(jax.devices()[0], "device_kind", "")
-    peak = next((v for k, v in peaks_tflops.items() if k in kind), None)
+    return next((v for k, v in _PEAKS_TFLOPS_BF16.items() if k in kind), None)
+
+
+def _fwd_flops(models, sym, **shapes):
+    """Analytic forward FLOPs/sample via models.recipe.estimate_flops
+    (MAC convention: ResNet-50@224 ≈ 4.1e9). None when the symbol holds an
+    op the estimator can't shape-infer — MFU is then omitted, not wrong."""
+    try:
+        return float(models.recipe.estimate_flops(sym, **shapes))
+    except Exception:
+        return None
+
+
+def _maybe_mfu(record, samples_per_sec, jax, on_tpu, dtype, flops_per_sample,
+               key="mfu"):
+    """Attach model-FLOPs-utilization when the analytic per-sample FLOPs
+    and the device-kind bf16 peak are both known. ``flops_per_sample`` is
+    the full cost of what the rate counts — callers pass 3x the forward
+    estimate for train rates (fwd + input-grad + weight-grad)."""
+    if not (on_tpu and dtype == "bfloat16" and flops_per_sample):
+        return
+    peak = _peak_tflops(jax)
     if peak:
-        record["mfu"] = round(img_per_sec * 12.3e9 / (peak * 1e12), 3)
+        record[key] = round(
+            samples_per_sec * flops_per_sample / (peak * 1e12), 3)
+
+
+def _resnet_train_flops(models, num_layers, image, batch_size):
+    """Train FLOPs/img for the train/fit headline records (3x forward; at
+    50 layers @224 this reproduces the 12.3 GFLOP/img the MFU field has
+    used since PR-3, now computed rather than hardcoded)."""
+    sym = models.resnet(num_classes=1000, num_layers=num_layers,
+                        image_shape=",".join(map(str, image)))
+    fwd = _fwd_flops(models, sym, data=(batch_size,) + image)
+    return 3.0 * fwd if fwd else None
 
 
 def _sweep_fit(mx, models, batch_size, image, dtype, num_layers, on_tpu,
@@ -642,6 +691,495 @@ def _run_ckpt_mode(mx, models, batch_size, image, dtype, num_layers,
     print(json.dumps(record))
 
 
+# ---------------------------------------------------------------------------
+# BENCH_MODE=suite — the whole-zoo scoreboard: every BASELINE workload
+# (MLP/LeNet, ResNet-50, bucketed LSTM-PTB, SSD-VGG16, DCGAN) through the
+# modern stack (fused K-step windows, pipelined dispatch, device metrics),
+# each leg reporting train+infer samples/s, analytic GFLOPs/sample (MFU on
+# TPU bf16), dtype, window K, dispatch depth and the STEADY-STATE compile
+# count (executor.jit_compile + executor.fused_plan_compile over the timed
+# region — the zero-recompile invariant, counter-verified).
+#
+# BENCH_MODE=score — the inference sweep over the 14 zoo symbols of the
+# published perf table, sharing both the symbol list (models.SCORE_SYMBOLS)
+# and the scoring loop with examples/benchmark_score.py.
+
+
+def _suite_cfg(on_tpu):
+    """(window K, dispatch depth, timed windows, warmup windows,
+    infer iters) — BENCH_SUITE_* env-tunable, cpu-smoke-sized defaults."""
+    return (
+        max(1, int(os.environ.get("BENCH_SUITE_K", 16 if on_tpu else 2))),
+        max(1, int(os.environ.get("BENCH_SUITE_DEPTH", 2))),
+        max(1, int(os.environ.get("BENCH_SUITE_WINDOWS",
+                                  8 if on_tpu else 2))),
+        max(1, int(os.environ.get("BENCH_SUITE_WARMUP", 2))),
+        max(1, int(os.environ.get("BENCH_SUITE_INFER_ITERS",
+                                  20 if on_tpu else 3))),
+    )
+
+
+def _steady_compiles(mx):
+    """Programs compiled since the last telemetry reset: AOTProgram builds
+    (executor.jit_compile) + fused-window plan builds
+    (executor.fused_plan_compile). The suite resets telemetry after warmup,
+    so over a timed region this is the steady-state compile count — the
+    acceptance invariant is that every workload pins it at 0."""
+    tm = mx.telemetry
+    return int(tm.counter("executor.jit_compile").value
+               + tm.counter("executor.fused_plan_compile").value)
+
+
+def _boundary_fence(boundary):
+    """One-scalar device->host fetch off a WindowBoundary output: the only
+    true execution barrier on every backend (block_until_ready can ack
+    before remote execution completes on tunneled runtimes)."""
+    if boundary is not None and boundary._outs:
+        np.asarray(boundary._outs[0].ravel()[:1])
+
+
+def _pipelined_windows(mx, dispatch, windows, depth, samples_per_window):
+    """Time `windows` dispatches with `depth` windows in flight (the fit
+    loop's backpressure discipline). Caller has already warmed up and
+    fenced; telemetry is reset here so the compile count covers exactly
+    the timed region. Returns (samples/sec, steady_compiles)."""
+    from collections import deque
+
+    mx.telemetry.reset()
+    inflight = deque()
+    last = None
+    tic = time.time()
+    for _ in range(windows):
+        last = dispatch()
+        inflight.append(last)
+        while len(inflight) > depth:
+            inflight.popleft().wait()
+    while inflight:
+        inflight.popleft().wait()
+    _boundary_fence(last)
+    dt = time.time() - tic
+    # post-timing finiteness probe (one host fetch, outside the clock):
+    # the bf16 recipes must train without NaN/Inf in the published outputs
+    finite = True
+    if last is not None and last._outs:
+        finite = bool(np.all(np.isfinite(
+            np.asarray(last._outs[0], dtype=np.float32))))
+    return samples_per_window * windows / dt, _steady_compiles(mx), finite
+
+
+def _forward_rate(mx, mod, batch, iters, warmup):
+    """Forward-only samples/s with the benchmark_score dispatch/fence
+    idiom (touch the output buffer to dispatch; fetch one scalar to
+    fence). Returns (samples/sec, steady_compiles)."""
+    def dispatch():
+        mod.forward(batch, is_train=False)
+        mod.get_outputs()[0]._data
+
+    def fence():
+        np.asarray(mod.get_outputs()[0]._data.ravel()[:1])
+
+    for _ in range(max(1, warmup)):
+        dispatch()
+    fence()
+    mx.telemetry.reset()
+    tic = time.time()
+    for _ in range(iters):
+        dispatch()
+    fence()
+    rate = batch.data[0].shape[0] * iters / (time.time() - tic)
+    return rate, _steady_compiles(mx)
+
+
+def _workload_record(jax, on_tpu, train_rate, infer_rate, dtype, k, depth,
+                     steady, fwd_flops, train_flops=None, finite=True):
+    """One scoreboard row. ``steady`` is the train-leg steady-state compile
+    count; ``train_flops`` defaults to 3x forward (fwd + input-grad +
+    weight-grad), overridden by workloads whose step does more passes
+    (DCGAN's three D passes)."""
+    rec = {
+        "train_samples_per_sec": round(train_rate, 2),
+        "infer_samples_per_sec": round(infer_rate, 2),
+        "dtype": dtype,
+        "window_k": k,
+        "dispatch_depth": depth,
+        "steady_compiles": steady,
+        "train_outputs_finite": finite,
+    }
+    if fwd_flops:
+        # 6 decimals: the MLP head is ~1e-4 GFLOPs/sample and must not
+        # round to a falsy 0.0
+        rec["gflops_per_sample_fwd"] = round(fwd_flops / 1e9, 6)
+        _maybe_mfu(rec, train_rate, jax, on_tpu, dtype,
+                   train_flops or 3.0 * fwd_flops, key="mfu_train")
+        _maybe_mfu(rec, infer_rate, jax, on_tpu, dtype, fwd_flops,
+                   key="mfu_infer")
+    return rec
+
+
+def _train_leg(mx, mod, batch, k, depth, windows, warmup, samples_per_step):
+    """Warm a Module's fused K-step window program, then time pipelined
+    window dispatches. Returns (samples/sec, steady_compiles, finite)."""
+    for _ in range(warmup):
+        mod.train_window(batch, k, publish_grads=False).wait()
+    _boundary_fence(mod.train_window(batch, k, publish_grads=False))
+    return _pipelined_windows(
+        mx, lambda: mod.train_window(batch, k, publish_grads=False),
+        windows, depth, samples_per_step * k)
+
+
+def _suite_classifier(mx, models, jax, on_tpu, sym, data_shape, num_classes,
+                      dtype, cfg, init=None, optimizer_params=None):
+    """Shared train+infer legs for the single-input classifier-shaped
+    workloads (MLP, LeNet, ResNet, SSD-train rides the same path with its
+    own label plumbing — see _suite_ssd)."""
+    k, depth, windows, warmup, infer_iters = cfg
+    bs = data_shape[0]
+    ctx = mx.gpu() if on_tpu else mx.cpu()
+    mod = mx.mod.Module(sym, context=ctx)
+    mod.bind(data_shapes=[mx.io.DataDesc("data", data_shape, dtype)],
+             label_shapes=[mx.io.DataDesc("softmax_label", (bs,))])
+    mod.init_params(initializer=init or mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd", optimizer_params=optimizer_params or
+                       {"learning_rate": 0.01, "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.uniform(-1, 1, data_shape).astype(np.float32),
+                       dtype=dtype)
+    label = mx.nd.array(rng.randint(0, num_classes, (bs,)).astype(np.float32))
+    batch = mx.io.DataBatch(data=[data], label=[label])
+    train_rate, steady, finite = _train_leg(mx, mod, batch, k, depth,
+                                            windows, warmup, bs)
+
+    imod = mx.mod.Module(sym, context=ctx)
+    imod.bind(data_shapes=[mx.io.DataDesc("data", data_shape, dtype)],
+              for_training=False)
+    imod.init_params(initializer=init or mx.init.Xavier())
+    infer_rate, _ = _forward_rate(mx, imod, batch, infer_iters, warmup)
+    fwd = _fwd_flops(models, sym, data=data_shape)
+    return _workload_record(jax, on_tpu, train_rate, infer_rate, dtype, k,
+                            depth, steady, fwd, finite=finite)
+
+
+def _suite_mlp(mx, models, jax, on_tpu, dtype, cfg):
+    bs = 1024 if on_tpu else 64
+    return _suite_classifier(mx, models, jax, on_tpu,
+                             models.mlp(num_classes=10, dtype=dtype),
+                             (bs, 784), 10, dtype, cfg)
+
+
+def _suite_lenet(mx, models, jax, on_tpu, dtype, cfg):
+    bs = 512 if on_tpu else 64
+    return _suite_classifier(mx, models, jax, on_tpu,
+                             models.lenet(num_classes=10, dtype=dtype),
+                             (bs, 1, 28, 28), 10, dtype, cfg)
+
+
+def _suite_resnet50(mx, models, jax, on_tpu, dtype, cfg):
+    bs = 128 if on_tpu else 4
+    image = (3, 224, 224) if on_tpu else (3, 64, 64)
+    sym = models.resnet(num_classes=1000, num_layers=50,
+                        image_shape=",".join(map(str, image)))
+    return _suite_classifier(
+        mx, models, jax, on_tpu, sym, (bs,) + image, 1000, dtype, cfg,
+        init=mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                            magnitude=2))
+
+
+def _suite_ssd(mx, models, jax, on_tpu, dtype, cfg):
+    """SSD-VGG16: the multi-loss Group trains through the same fused
+    window machinery as the classifiers (MultiBoxTarget in-graph, f32
+    anchor math under the bf16 trunk recipe); the infer leg scores the
+    detection symbol (SoftmaxActivation + in-graph NMS)."""
+    k, depth, windows, warmup, infer_iters = cfg
+    bs = 16 if on_tpu else 2
+    size = 300 if on_tpu else 64
+    num_classes = 20 if on_tpu else 3
+    max_obj, obj_w = 4, 5  # ImageDetRecordIter layout: [cls,x1,y1,x2,y2]
+    ctx = mx.gpu() if on_tpu else mx.cpu()
+    net = models.ssd.get_symbol_train(num_classes=num_classes,
+                                      data_shape=size, dtype=dtype)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=ctx)
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (bs, 3, size, size), dtype)],
+             label_shapes=[mx.io.DataDesc("label", (bs, max_obj, obj_w))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.002,
+                                         "momentum": 0.9, "wd": 5e-4})
+    rng = np.random.RandomState(0)
+    label = np.full((bs, max_obj, obj_w), -1.0, np.float32)
+    for i in range(bs):
+        for j in range(rng.randint(1, max_obj + 1)):
+            x1, y1 = rng.uniform(0, 0.5, 2)
+            w, h = rng.uniform(0.2, 0.5, 2)
+            label[i, j] = [rng.randint(0, num_classes), x1, y1,
+                           min(1.0, x1 + w), min(1.0, y1 + h)]
+    data = mx.nd.array(
+        rng.uniform(-1, 1, (bs, 3, size, size)).astype(np.float32),
+        dtype=dtype)
+    batch = mx.io.DataBatch(data=[data], label=[mx.nd.array(label)])
+    train_rate, steady, finite = _train_leg(mx, mod, batch, k, depth,
+                                            windows, warmup, bs)
+
+    det = models.ssd.get_symbol(num_classes=num_classes, data_shape=size,
+                                dtype=dtype)
+    imod = mx.mod.Module(det, data_names=("data",), label_names=None,
+                         context=ctx)
+    imod.bind(data_shapes=[mx.io.DataDesc("data", (bs, 3, size, size),
+                                          dtype)],
+              for_training=False)
+    imod.init_params(initializer=mx.init.Xavier())
+    infer_rate, _ = _forward_rate(mx, imod, batch, infer_iters, warmup)
+    fwd = _fwd_flops(models, net, data=(bs, 3, size, size),
+                     label=(bs, max_obj, obj_w))
+    return _workload_record(jax, on_tpu, train_rate, infer_rate, dtype, k,
+                            depth, steady, fwd, finite=finite)
+
+
+def _suite_lstm(mx, models, jax, on_tpu, dtype, cfg):
+    """Bucketed LSTM-PTB: a materialized synthetic epoch chunks into
+    K-batch windows through BucketingModule.train_window (grouped by
+    bucket, one fused program per (bucket, group size) — after the warmup
+    epoch every program is cached, so the timed epochs dispatch with zero
+    compiles and zero per-batch host syncs). RNN legs run f32: the
+    low-precision recipes cover the conv trunks, not the recurrent
+    matmuls."""
+    del dtype  # rnn leg is f32 by design; record says so explicitly
+    k, depth, windows, warmup, _ = cfg
+    bs = 32 if on_tpu else 8
+    hidden = embed = 200 if on_tpu else 32
+    vocab = 10000 if on_tpu else 100
+    buckets = [16, 32] if on_tpu else [8, 16]
+    rs = np.random.RandomState(0)
+    sents = [[int(x) for x in rs.randint(1, vocab, int(rs.choice(buckets)))]
+             for _ in range(bs * (8 if on_tpu else 4))]
+    it = mx.rnn.BucketSentenceIter(sents, bs, buckets=buckets,
+                                   invalid_label=0)
+    sym_gen, state_names = models.lstm_lm_sym_gen(
+        num_hidden=hidden, num_layers=2, num_embed=embed, vocab_size=vocab)
+    ctx = mx.gpu() if on_tpu else mx.cpu()
+    mod = mx.mod.BucketingModule(sym_gen=sym_gen,
+                                 default_bucket_key=it.default_bucket_key,
+                                 state_names=state_names, context=ctx)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier(factor_type="in",
+                                               magnitude=2.34))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    # one materialized epoch, reused verbatim every timed pass: identical
+    # chunking -> identical (bucket, group size) pairs -> pure cache picks
+    batches = list(it)
+    chunks = [batches[i:i + k] for i in range(0, len(batches), k)]
+    for _ in range(warmup):
+        for ch in chunks:
+            mod.train_window(None, batches=ch, publish_grads=False).wait()
+
+    from collections import deque
+
+    mx.telemetry.reset()
+    inflight = deque()
+    last = None
+    tic = time.time()
+    for _ in range(windows):
+        for ch in chunks:
+            last = mod.train_window(None, batches=ch, publish_grads=False)
+            inflight.append(last)
+            while len(inflight) > depth:
+                inflight.popleft().wait()
+    while inflight:
+        inflight.popleft().wait()
+    _boundary_fence(last)
+    dt = time.time() - tic
+    train_rate = windows * len(batches) * bs / dt
+    steady = _steady_compiles(mx)
+    finite = bool(last is not None and last._outs and np.all(
+        np.isfinite(np.asarray(last._outs[0], dtype=np.float32))))
+
+    # infer: forward-only through the bound bucket programs (samples are
+    # sequences); flops = bucket-length-weighted forward estimate
+    fb = next(b for b in batches if b.bucket_key == it.default_bucket_key)
+    for _ in range(2):
+        mod.forward(fb, is_train=False)
+        mod.get_outputs()[0]._data
+    np.asarray(mod.get_outputs()[0]._data.ravel()[:1])
+    tic = time.time()
+    iters = max(1, 2 * len(batches))
+    for _ in range(iters):
+        mod.forward(fb, is_train=False)
+        mod.get_outputs()[0]._data
+    np.asarray(mod.get_outputs()[0]._data.ravel()[:1])
+    infer_rate = bs * iters / (time.time() - tic)
+
+    counts = {}
+    for b in batches:
+        counts[b.bucket_key] = counts.get(b.bucket_key, 0) + 1
+    fwd, tot = 0.0, 0
+    for length, c in counts.items():
+        shapes = {"data": (bs, length), "softmax_label": (bs, length)}
+        for sn in state_names:
+            shapes[sn] = (bs, hidden)
+        f = _fwd_flops(models, sym_gen(length)[0], **shapes)
+        if f:
+            fwd, tot = fwd + f * c, tot + c
+    return _workload_record(jax, on_tpu, train_rate, infer_rate, "float32",
+                            k, depth, steady, fwd / tot if tot else None,
+                            finite=finite)
+
+
+def _suite_dcgan(mx, models, jax, on_tpu, dtype, cfg):
+    """DCGAN: the alternating G/D step is one fused device-resident
+    program (GANModule.train_window, in-graph latent sampling). The record
+    carries the reference imperative loop's rate too
+    (legacy_train_samples_per_sec) so the fused-vs-legacy win is pinned in
+    the scoreboard. Train cost/sample ≈ 3 G passes + 9 D passes (three D
+    forwards, two with full backward, one for input grads); infer is pure
+    G generation."""
+    del dtype  # GAN leg is f32 (reference recipe); record says so
+    k, depth, windows, warmup, infer_iters = cfg
+    bs = 64 if on_tpu else 4
+    z_dim = 100 if on_tpu else 16
+    nf = 64 if on_tpu else 8
+    ctx = mx.gpu() if on_tpu else mx.cpu()
+    mx.random.seed(0)
+    g_sym = models.dcgan_generator(ngf=nf, nc=3)
+    d_sym = models.dcgan_discriminator(ndf=nf)
+    gan = mx.mod.GANModule(g_sym, d_sym, context=ctx, batch_size=bs,
+                           code_shape=(z_dim, 1, 1), data_shape=(3, 64, 64))
+    gan.bind()
+    gan.init_params()
+    gan.init_optimizer()
+    rng = np.random.RandomState(0)
+    real = mx.nd.array(rng.rand(bs, 3, 64, 64).astype(np.float32) * 2 - 1)
+    for _ in range(warmup):
+        gan.train_window(real, k).wait()
+    _boundary_fence(gan.train_window(real, k))
+    train_rate, steady, finite = _pipelined_windows(
+        mx, lambda: gan.train_window(real, k), windows, depth, bs * k)
+
+    # reference imperative loop on the same per-window step count — its
+    # rate is the fused path's acceptance floor. The boundary's outputs
+    # are the PRE-update real-pass reads, so fencing them would leave the
+    # trailing G/D updates untimed (the fused program can't cheat that
+    # way: any output fetch forces the whole XLA call) — fence on the
+    # updated weights instead.
+    def weight_fence():
+        for m in (gan.mod_g, gan.mod_d):
+            exe = m._exec_group._exec
+            name = next(iter(exe.arg_dict))
+            np.asarray(exe.arg_dict[name]._data.ravel()[:1])
+
+    gan._serial_window([real] * k, None)  # warm the serial-path programs
+    weight_fence()
+    tic = time.time()
+    legacy_windows = max(1, windows // 2) if on_tpu else windows
+    for _ in range(legacy_windows):
+        gan._serial_window([real] * k, None)
+    weight_fence()
+    legacy_rate = bs * k * legacy_windows / (time.time() - tic)
+
+    imod = mx.mod.Module(g_sym, data_names=("rand",), label_names=None,
+                         context=ctx)
+    imod.bind(data_shapes=[mx.io.DataDesc("rand", (bs, z_dim, 1, 1))],
+              for_training=False)
+    imod.init_params(initializer=mx.init.Normal(0.02))
+    noise = mx.nd.random_normal(loc=0, scale=1, shape=(bs, z_dim, 1, 1))
+    infer_rate, _ = _forward_rate(
+        mx, imod, mx.io.DataBatch(data=[noise], label=[]), infer_iters, 2)
+
+    g_fwd = _fwd_flops(models, g_sym, rand=(bs, z_dim, 1, 1))
+    d_fwd = _fwd_flops(models, d_sym, data=(bs, 3, 64, 64), label=(bs,))
+    train_flops = 3.0 * (g_fwd + 3.0 * d_fwd) if g_fwd and d_fwd else None
+    rec = _workload_record(jax, on_tpu, train_rate, infer_rate, "float32",
+                           k, depth, steady, g_fwd, train_flops=train_flops,
+                           finite=finite)
+    rec["legacy_train_samples_per_sec"] = round(legacy_rate, 2)
+    rec["fused_speedup"] = round(train_rate / legacy_rate, 3)
+    return rec
+
+
+_SUITE_RUNNERS = (
+    ("mlp", _suite_mlp),
+    ("lenet", _suite_lenet),
+    ("resnet-50", _suite_resnet50),
+    ("lstm-ptb", _suite_lstm),
+    ("ssd-vgg16", _suite_ssd),
+    ("dcgan", _suite_dcgan),
+)
+
+
+def _run_suite_mode(mx, models, jax, on_tpu):
+    """BENCH_MODE=suite: one JSON scoreboard covering every BASELINE
+    workload; headline value is the geomean train samples/s (unit-hostile
+    across workloads, but stable under proportional regressions — the
+    bench_compare gate diffs the per-workload fields)."""
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16" if on_tpu else "float32")
+    cfg = _suite_cfg(on_tpu)
+    subset = os.environ.get("BENCH_SUITE_WORKLOADS")
+    wanted = ([n.strip() for n in subset.split(",") if n.strip()]
+              if subset else [n for n, _ in _SUITE_RUNNERS])
+    runners = dict(_SUITE_RUNNERS)
+    unknown = [n for n in wanted if n not in runners]
+    if unknown:
+        raise SystemExit(f"BENCH_SUITE_WORKLOADS: unknown {unknown}; "
+                         f"have {[n for n, _ in _SUITE_RUNNERS]}")
+    workloads = {}
+    for name in wanted:
+        print(f"suite: {name} ...", file=sys.stderr)
+        workloads[name] = runners[name](mx, models, jax, on_tpu, dtype, cfg)
+    rates = [w["train_samples_per_sec"] for w in workloads.values()]
+    record = {
+        "metric": "whole_zoo_suite" + ("" if on_tpu else "_cpusmoke"),
+        "value": round(float(np.exp(np.mean(np.log(rates)))), 2),
+        "unit": "geomean train samples/sec",
+        "window_k": cfg[0],
+        "dispatch_depth": cfg[1],
+        "workloads": workloads,
+    }
+    _maybe_mesh(record, mx)
+    print(json.dumps(record))
+
+
+def _run_score_mode(mx, models, jax, on_tpu):
+    """BENCH_MODE=score: the published-table inference sweep. The symbol
+    list AND the scoring loop live in one place each (models.SCORE_SYMBOLS,
+    examples/benchmark_score.score) so this mode cannot drift from the
+    example. BENCH_SCORE_NETS subsets for cpu smoke."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "examples"))
+    import benchmark_score
+
+    subset = os.environ.get("BENCH_SCORE_NETS")
+    networks = ([n.strip() for n in subset.split(",") if n.strip()]
+                if subset else list(models.SCORE_SYMBOLS))
+    bs = int(os.environ.get("BENCH_SCORE_BATCH", 32 if on_tpu else 2))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16" if on_tpu else "float32")
+    iters = int(os.environ.get("BENCH_ITERS", 20 if on_tpu else 2))
+    side = int(os.environ.get("BENCH_IMAGE", 224))
+    image = (3, side, side)
+    results = {}
+    for net in networks:
+        print(f"score: {net} ...", file=sys.stderr)
+        rate = benchmark_score.score(net, bs, image, dtype, iters=iters,
+                                     warmup=3 if on_tpu else 1)
+        entry = {"samples_per_sec": round(rate, 2)}
+        fwd = _fwd_flops(models, models.zoo.get_symbol(net),
+                         data=(bs,) + image)
+        if fwd:
+            entry["gflops_per_sample_fwd"] = round(fwd / 1e9, 3)
+            _maybe_mfu(entry, rate, jax, on_tpu, dtype, fwd)
+        results[net] = entry
+    rates = [e["samples_per_sec"] for e in results.values()]
+    record = {
+        "metric": "zoo_score_sweep" + ("" if on_tpu else "_cpusmoke"),
+        "value": round(float(np.exp(np.mean(np.log(rates)))), 2),
+        "unit": "geomean images/sec",
+        "batch_size": bs,
+        "dtype": dtype,
+        "networks": results,
+    }
+    _maybe_mesh(record, mx)
+    print(json.dumps(record))
+
+
 def main():
     import jax
 
@@ -659,6 +1197,14 @@ def main():
     windows = max(1, int(os.environ.get("BENCH_WINDOWS", 4 if on_tpu else 1)))
     num_layers = int(os.environ.get("BENCH_LAYERS", 50))
     image = (3, 224, 224) if on_tpu else (3, 64, 64)
+
+    if mode == "suite":
+        _run_suite_mode(mx, models, jax, on_tpu)
+        return
+
+    if mode == "score":
+        _run_score_mode(mx, models, jax, on_tpu)
+        return
 
     if mode == "serve":
         _run_serve_mode(mx, models, image, num_layers, on_tpu)
@@ -709,7 +1255,8 @@ def main():
             "cold_compile_s": round(cold_compile_s, 3),
             "telemetry": snapshot,
         }
-        _maybe_mfu(record, img_per_sec, jax, on_tpu, num_layers, dtype)
+        _maybe_mfu(record, img_per_sec, jax, on_tpu, dtype,
+                   _resnet_train_flops(models, num_layers, image, batch_size))
         _maybe_mesh(record, mx)
         window_k = mx.telemetry.gauge("fit.train_window_k").value
         if window_k:
@@ -829,7 +1376,8 @@ def main():
         record["guard_on_img_per_sec"] = round(guard_rate, 2)
         record["nonfinite_guard_overhead"] = round(
             1.0 - guard_rate / img_per_sec, 4)
-    _maybe_mfu(record, img_per_sec, jax, on_tpu, num_layers, dtype)
+    _maybe_mfu(record, img_per_sec, jax, on_tpu, dtype,
+               _resnet_train_flops(models, num_layers, image, batch_size))
     _maybe_mesh(record, mx)
     print(json.dumps(record))
 

@@ -23,23 +23,9 @@ from mxnet_tpu import models
 
 
 def get_symbol(network, **kwargs):
-    if network.startswith("resnet-"):
-        return models.resnet(num_classes=1000,
-                             num_layers=int(network.split("-")[1]), **kwargs)
-    if network.startswith("resnext-"):
-        return models.resnext(num_classes=1000,
-                              num_layers=int(network.split("-")[1]))
-    factories = {
-        "vgg": models.vgg,
-        "inception-bn": models.inception_bn,
-        "inception-v3": models.inception_v3,
-        "googlenet": models.googlenet,
-        "alexnet": models.alexnet,
-        "mlp": lambda num_classes: models.mlp(),
-    }
-    if network in factories:
-        return factories[network](num_classes=1000)
-    raise ValueError(f"unknown network {network}")
+    # single source of truth shared with bench.py's BENCH_MODE=score —
+    # see mxnet_tpu/models/zoo.py
+    return models.zoo.get_symbol(network, num_classes=1000, **kwargs)
 
 
 def score(network, batch_size, image_shape=(3, 224, 224), dtype="float32",
@@ -114,7 +100,7 @@ def main():
     dtype = args.dtype or ("bfloat16" if on_accel else "float32")
     image_shape = tuple(int(x) for x in args.image_shape.split(","))
     networks = [args.network] if args.network else \
-        ["resnet-50", "inception-bn", "vgg"]
+        list(models.SCORE_SYMBOLS)
     batch_sizes = [args.batch_size] if args.batch_size else [1, 32]
 
     results = {}

@@ -1,11 +1,21 @@
 #!/usr/bin/env python
 """DCGAN training (reference example/gan/dcgan.py): two Modules trained
-adversarially — D on real+fake, G through D's input gradients."""
+adversarially — D on real+fake, G through D's input gradients.
+
+The modern path (default) drives ``mx.mod.GANModule``: the whole
+alternating G/D step is one fused device-resident program with in-graph
+``jax.random`` latent sampling, and K steps dispatch as one window
+(``--window``) with ``--depth`` windows in flight. ``--legacy`` runs the
+reference's imperative per-batch loop (framework-seeded latents via
+``mx.nd.random_normal`` — NOT host numpy, so ``mx.random.seed`` makes runs
+reproducible end to end).
+"""
 
 import argparse
 import logging
 import os
 import sys
+from collections import deque
 
 import numpy as np
 
@@ -31,82 +41,58 @@ def main():
     parser.add_argument("--beta1", type=float, default=0.5)
     parser.add_argument("--num-batches", type=int, default=50,
                         help="batches/epoch of synthetic 'real' data")
+    parser.add_argument("--window", type=int, default=4,
+                        help="fused train steps per dispatch")
+    parser.add_argument("--depth", type=int, default=2,
+                        help="windows in flight before blocking")
+    parser.add_argument("--legacy", action="store_true",
+                        help="reference imperative per-batch loop")
+    parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     ctx = mx.gpu() if mx.num_gpus() else mx.cpu()
     bs, Z = args.batch_size, args.z_dim
+    mx.random.seed(args.seed)
 
-    gen = models.dcgan_generator(ngf=args.ngf, nc=3)
-    disc = models.dcgan_discriminator(ndf=args.ndf)
-
-    mod_g = mx.mod.Module(gen, data_names=("rand",), label_names=None, context=ctx)
-    mod_g.bind(data_shapes=[("rand", (bs, Z, 1, 1))])
-    mod_g.init_params(initializer=mx.init.Normal(0.02))
-    mod_g.init_optimizer(
-        optimizer="adam",
-        optimizer_params={"learning_rate": args.lr, "beta1": args.beta1},
+    gan = mx.mod.GANModule(
+        models.dcgan_generator(ngf=args.ngf, nc=3),
+        models.dcgan_discriminator(ndf=args.ndf),
+        context=ctx, batch_size=bs, code_shape=(Z, 1, 1),
+        data_shape=(3, 64, 64),
     )
-
-    mod_d = mx.mod.Module(disc, data_names=("data",), label_names=("label",),
-                          context=ctx)
-    mod_d.bind(
-        data_shapes=[("data", (bs, 3, 64, 64))],
-        label_shapes=[("label", (bs,))], inputs_need_grad=True,
-    )
-    mod_d.init_params(initializer=mx.init.Normal(0.02))
-    mod_d.init_optimizer(
+    gan.bind()
+    gan.init_params(initializer=mx.init.Normal(0.02))
+    gan.init_optimizer(
         optimizer="adam",
         optimizer_params={"learning_rate": args.lr, "beta1": args.beta1},
     )
 
     metric_acc = mx.metric.CustomMetric(facc)
-    rs = np.random.RandomState(0)
+    rs = np.random.RandomState(args.seed)
+    ones = mx.nd.ones((bs,))
 
     for epoch in range(args.num_epochs):
         metric_acc.reset()
-        for t in range(args.num_batches):
-            real = mx.nd.array(
-                rs.rand(bs, 3, 64, 64).astype(np.float32) * 2 - 1
-            )
-            noise = mx.nd.array(rs.randn(bs, Z, 1, 1).astype(np.float32))
-
-            # generate
-            mod_g.forward(mx.io.DataBatch(data=[noise], label=None), is_train=True)
-            fake = mod_g.get_outputs()[0]
-
-            # update D: fake(0) + real(1)
-            mod_d.forward(
-                mx.io.DataBatch(data=[fake], label=[mx.nd.zeros((bs,))]),
-                is_train=True,
-            )
-            mod_d.backward()
-            grads_fake = [
-                [g.copy() for g in gl] for gl in
-                (mod_d._exec_group.grad_arrays,)
-            ][0]
-            mod_d.forward(
-                mx.io.DataBatch(data=[real], label=[mx.nd.ones((bs,))]),
-                is_train=True,
-            )
-            mod_d.backward()
-            # accumulate fake grads (reference adds the two D passes)
-            for gl, gf in zip(mod_d._exec_group.grad_arrays, grads_fake):
-                if gl[0] is not None:
-                    gl[0] += gf[0]
-            mod_d.update()
-            metric_acc.update([mx.nd.ones((bs,))], mod_d.get_outputs())
-
-            # update G via D's input gradients at label=1
-            mod_d.forward(
-                mx.io.DataBatch(data=[fake], label=[mx.nd.ones((bs,))]),
-                is_train=True,
-            )
-            mod_d.backward()
-            diff_d = mod_d.get_input_grads()
-            mod_g.backward(diff_d)
-            mod_g.update()
-
+        reals = [
+            mx.nd.array(rs.rand(bs, 3, 64, 64).astype(np.float32) * 2 - 1)
+            for _ in range(args.num_batches)
+        ]
+        if args.legacy:
+            for real in reals:
+                boundary = gan._serial_window([real], None)
+                metric_acc.update([ones], boundary.outputs)
+        else:
+            inflight = deque()
+            for i in range(0, len(reals), args.window):
+                boundary = gan.train_window(None,
+                                            batches=reals[i:i + args.window])
+                inflight.append(boundary)
+                while len(inflight) >= args.depth:
+                    done = inflight.popleft()
+                    metric_acc.update([ones], done.outputs)
+            for done in inflight:
+                metric_acc.update([ones], done.outputs)
         name, acc = metric_acc.get()
         logging.info("epoch %d: D real-acc %.3f", epoch, acc)
 

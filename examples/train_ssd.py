@@ -60,6 +60,10 @@ def main():
     parser.add_argument("--num-epochs", type=int, default=2)
     parser.add_argument("--lr", type=float, default=0.002)
     parser.add_argument("--num-images", type=int, default=16)
+    parser.add_argument("--dtype", type=str, default="float32",
+                        choices=("float32", "bfloat16", "float16"),
+                        help="trunk compute dtype (bf16 recipe: VGG trunk "
+                             "low-precision, anchor/target math f32)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -81,34 +85,27 @@ def main():
     )
 
     net = models.ssd.get_symbol_train(num_classes=args.num_classes,
-                                      data_shape=args.data_shape)
+                                      data_shape=args.data_shape,
+                                      dtype=args.dtype)
     ctx = mx.gpu(0) if mx.num_gpus() else mx.cpu()
     mod = mx.mod.Module(
         net, data_names=("data",), label_names=("label",), context=ctx,
     )
-    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
-    mod.init_params(initializer=mx.init.Xavier())
-    mod.init_optimizer(
+    # fit drives the multi-loss Group through the same modern stack as the
+    # classifiers: device metric accumulation, and (under MXNET_TRAIN_WINDOW
+    # / MXNET_DISPATCH_DEPTH / MXNET_DEVICE_PREFETCH) fused K-step windows
+    # with pipelined dispatch — no per-batch host sync anywhere
+    mod.fit(
+        train_data=it,
+        eval_metric=mx.metric.Loss(name="ssd_loss"),
         optimizer="sgd",
         optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
                           "wd": 5e-4},
+        initializer=mx.init.Xavier(),
+        batch_end_callback=mx.callback.Speedometer(args.batch_size, 2),
+        num_epoch=args.num_epochs,
     )
-    metric = mx.metric.Loss(name="cls_loss")
-    for epoch in range(args.num_epochs):
-        it.reset()
-        metric.reset()
-        nbatch = 0
-        for batch in it:
-            mod.forward_backward(batch)
-            mod.update()
-            outs = mod.get_outputs()
-            # outputs: [cls_prob, loc_loss, cls_label, det]
-            loc_loss = float(outs[1].asnumpy().sum())
-            nbatch += 1
-            if nbatch % 2 == 0:
-                logging.info("epoch %d batch %d loc_loss %.4f",
-                             epoch, nbatch, loc_loss)
-        logging.info("epoch %d done", epoch)
+    logging.info("done")
 
 
 if __name__ == "__main__":

@@ -368,6 +368,22 @@ class Perplexity(EvalMetric):
         self.sum_metric += _np.exp(loss / num) if num > 0 else 0.0
         self.num_inst += 1
 
+    def _device_batch(self, label, pred):
+        # same math as update() on the device accumulator — this is what
+        # keeps the bucketed LSTM fit free of per-batch host syncs
+        import jax.numpy as jnp
+
+        lab = label.reshape(-1).astype("int32")
+        p = pred.reshape(lab.shape[0], pred.shape[-1])
+        probs = jnp.take_along_axis(p, lab[:, None], axis=-1)[:, 0]
+        num = lab.shape[0]
+        if self.ignore_label is not None:
+            ignore = (lab == self.ignore_label).astype(p.dtype)
+            num = num - ignore.sum()
+            probs = probs * (1 - ignore) + ignore
+        loss = -jnp.sum(jnp.log(jnp.maximum(1e-10, probs)))
+        return jnp.where(num > 0, jnp.exp(loss / num), 0.0), 1
+
 
 class MAE(EvalMetric):
     def __init__(self, name="mae"):

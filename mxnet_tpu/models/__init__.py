@@ -22,3 +22,5 @@ from .dcgan import make_generator as dcgan_generator
 from .dcgan import make_discriminator as dcgan_discriminator
 from .lstm_lm import lstm_lm_serving_sym_gen, lstm_lm_sym_gen
 from . import ssd
+from . import zoo
+from .zoo import SCORE_SYMBOLS

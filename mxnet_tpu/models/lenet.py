@@ -1,10 +1,12 @@
 """LeNet for MNIST (reference example/image-classification/symbols/lenet.py)."""
 
 from .. import symbol as sym
+from .recipe import low_precision_io
 
 
-def get_symbol(num_classes=10, **kwargs):
+def get_symbol(num_classes=10, dtype="float32", **kwargs):
     data = sym.Variable("data")
+    data = low_precision_io(data, dtype)
     conv1 = sym.Convolution(data, kernel=(5, 5), num_filter=20, name="conv1")
     tanh1 = sym.Activation(conv1, act_type="tanh")
     pool1 = sym.Pooling(tanh1, pool_type="max", kernel=(2, 2), stride=(2, 2))
@@ -14,5 +16,6 @@ def get_symbol(num_classes=10, **kwargs):
     flatten = sym.Flatten(pool2)
     fc1 = sym.FullyConnected(flatten, num_hidden=500, name="fc1")
     tanh3 = sym.Activation(fc1, act_type="tanh")
+    tanh3 = low_precision_io(tanh3, dtype, out=True)
     fc2 = sym.FullyConnected(tanh3, num_hidden=num_classes, name="fc2")
     return sym.SoftmaxOutput(fc2, name="softmax")

@@ -1,4 +1,4 @@
-"""Shared low-precision training recipe for the imagenet symbols.
+"""Shared low-precision training recipe and the analytic FLOPs estimator.
 
 Reference: the explicit fp16 symbol variants
 (``example/image-classification/symbols/resnet_fp16.py`` /
@@ -7,7 +7,13 @@ and cast back to fp32 before the classifier so the softmax/loss runs in
 full precision. The TPU recipe is identical with bfloat16: the conv trunk
 runs bf16 on the MXU, master weights stay f32 (the executor's master-dtype
 rule), and the head computes in f32.
+
+``estimate_flops`` is the per-symbol analytic model that lets bench report
+MFU for every workload (conv/deconv/dense/rnn counted from the serialized
+graph + inferred shapes) instead of hardcoding ResNet-50@224.
 """
+
+import json
 
 from .. import symbol as sym
 
@@ -18,3 +24,97 @@ def low_precision_io(x, dtype, out=False):
     if dtype in (None, "float32"):
         return x
     return sym.Cast(x, dtype="float32" if out else dtype)
+
+
+def _prod(xs):
+    p = 1
+    for x in xs:
+        p *= int(x)
+    return p
+
+
+def _node_shape(shape_dict, nodes, node_ref):
+    """Inferred output shape of graph input ``node_ref`` = (node_id, out_idx).
+
+    Weight/data nulls are keyed by name; op outputs by ``<name>_output`` (or
+    ``<name>_output<idx>`` for multi-output ops). Returns None when the
+    internals listing doesn't carry the key.
+    """
+    node_id, out_idx = node_ref[0], node_ref[1]
+    node = nodes[node_id]
+    if node["op"] == "null":
+        return shape_dict.get(node["name"])
+    return shape_dict.get(node["name"] + "_output",
+                          shape_dict.get(f"{node['name']}_output{out_idx}"))
+
+
+def estimate_flops(symbol, batch=None, **shape_kwargs):
+    """Analytic forward FLOPs **per sample** for ``symbol``.
+
+    Counts Convolution, Deconvolution, FullyConnected and the fused RNN op
+    in the published-table convention (one multiply-add = one FLOP, the
+    convention behind the ResNet-50 = 4.1 GFLOPs/img figure that bench's
+    MFU numbers have used since PR-3); the unrolled LSTM graphs decompose
+    into FullyConnected nodes and are covered by the dense formula.
+    Elementwise, norm and pool ops are ignored (<1% of zoo-symbol FLOPs).
+    Training costs ≈ 3× the forward estimate (forward + input-grad +
+    weight-grad passes).
+
+    ``batch`` defaults to the leading dim of the first shape in
+    ``shape_kwargs`` — pass it explicitly for layouts whose leading dim is
+    not the batch axis (e.g. time-major RNN data).
+    """
+    nodes = json.loads(symbol.tojson())["nodes"]
+    internals = symbol.get_internals()
+    _, out_shapes, _ = internals.infer_shape(**shape_kwargs)
+    if out_shapes is None:
+        raise ValueError("input shapes underdetermine the graph")
+    shape_dict = dict(zip(internals.list_outputs(), out_shapes))
+    arg_shapes, _, _ = symbol.infer_shape(**shape_kwargs)
+    arg_shape = dict(zip(symbol.list_arguments(), arg_shapes))
+    if batch is None:
+        batch = int(next(iter(shape_kwargs.values()))[0])
+
+    total = 0.0
+    for node_id, node in enumerate(nodes):
+        op = node["op"]
+        if op not in ("Convolution", "Deconvolution", "FullyConnected", "RNN"):
+            continue
+        attrs = node.get("attrs") or {}
+        if op == "RNN":
+            # data (T, N, C); per layer/dir: gates × h × (in + h) MACs/step
+            data_shape = _node_shape(shape_dict, nodes, node["inputs"][0])
+            if not data_shape:
+                continue
+            seq_len, _, in_dim = (int(d) for d in data_shape[:3])
+            h = int(attrs["state_size"])
+            layers = int(attrs["num_layers"])
+            dirs = 2 if attrs.get("bidirectional", "False") == "True" else 1
+            gates = {"lstm": 4, "gru": 3}.get(attrs.get("mode"), 1)
+            macs = 0
+            for layer in range(layers):
+                in_l = in_dim if layer == 0 else h * dirs
+                macs += dirs * gates * h * (in_l + h)
+            total += 1.0 * seq_len * macs
+            continue
+        w = arg_shape.get(nodes[node["inputs"][1][0]]["name"])
+        if not w:
+            continue
+        if op == "FullyConnected":
+            # MACs = rows × num_hidden × in_dim; rows may exceed batch when
+            # the graph folds time into the leading axis (seq-major heads)
+            in_shape = _node_shape(shape_dict, nodes, node["inputs"][0])
+            rows = int(in_shape[0]) if in_shape else batch
+            total += 1.0 * (rows / batch) * _prod(w)
+        elif op == "Convolution":
+            out = _node_shape(shape_dict, nodes, (node_id, 0))
+            if not out:
+                continue
+            # per output position × per filter: in_ch/g × kh × kw MACs
+            total += 1.0 * _prod(out[2:]) * _prod(w)
+        else:  # Deconvolution: each input pixel scatters a full kernel
+            in_shape = _node_shape(shape_dict, nodes, node["inputs"][0])
+            if not in_shape:
+                continue
+            total += 1.0 * _prod(in_shape[2:]) * _prod(w)
+    return total

@@ -10,6 +10,7 @@ MakeLoss), MultiBoxDetection for inference.
 from __future__ import annotations
 
 from .. import symbol as sym
+from .recipe import low_precision_io
 from .vgg import get_feature as _vgg_feature  # noqa: F401  (backbone parity)
 
 
@@ -115,23 +116,33 @@ def multibox_layer(from_layers, num_classes, sizes=_SIZES, ratios=_RATIOS,
     return loc_preds, cls_preds, anchor_boxes
 
 
-def _heads(num_classes, data_shape=300):
+def _heads(num_classes, data_shape=300, dtype="float32"):
+    """bf16 recipe: the VGG trunk + extra scales run low-precision; each
+    feature map is cast back to f32 before L2Norm/multibox heads so the
+    anchor/target math stays full precision (same shape as the resnet
+    recipe — trunk on the MXU, head in f32)."""
     data = sym.Variable("data")
+    data = low_precision_io(data, dtype)
     backbone = _vgg16_reduced(data)
     conv4_3, fc7 = backbone
+    extras = _extra_layers(fc7, data_shape // 16)
+    conv4_3 = low_precision_io(conv4_3, dtype, out=True)
+    fc7 = low_precision_io(fc7, dtype, out=True)
+    extras = [low_precision_io(x, dtype, out=True) for x in extras]
     conv4_3_norm = sym.L2Normalization(conv4_3, mode="channel",
                                        name="conv4_3_norm") * 20.0
-    extras = _extra_layers(fc7, data_shape // 16)
     from_layers = [conv4_3_norm, fc7] + extras
     n = len(from_layers)
     return multibox_layer(from_layers, num_classes,
                           sizes=_SIZES[:n], ratios=_RATIOS[:n])
 
 
-def get_symbol_train(num_classes=20, data_shape=300, **kwargs):
+def get_symbol_train(num_classes=20, data_shape=300, dtype="float32",
+                     **kwargs):
     """Training symbol (reference symbol_builder.get_symbol_train)."""
     label = sym.Variable("label")
-    loc_preds, cls_preds, anchor_boxes = _heads(num_classes, data_shape)
+    loc_preds, cls_preds, anchor_boxes = _heads(num_classes, data_shape,
+                                                dtype)
 
     tmp = sym.MultiBoxTarget(
         anchor_boxes, label, cls_preds, overlap_threshold=0.5,
@@ -165,9 +176,10 @@ def get_symbol_train(num_classes=20, data_shape=300, **kwargs):
 
 
 def get_symbol(num_classes=20, nms_thresh=0.5, force_suppress=False,
-               nms_topk=400, data_shape=300, **kwargs):
+               nms_topk=400, data_shape=300, dtype="float32", **kwargs):
     """Inference symbol (reference symbol_builder.get_symbol)."""
-    loc_preds, cls_preds, anchor_boxes = _heads(num_classes, data_shape)
+    loc_preds, cls_preds, anchor_boxes = _heads(num_classes, data_shape,
+                                                dtype)
     cls_prob = sym.SoftmaxActivation(cls_preds, mode="channel",
                                      name="cls_prob")
     return sym.MultiBoxDetection(

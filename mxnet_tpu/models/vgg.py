@@ -2,6 +2,7 @@
 SSD backbone in example/ssd)."""
 
 from .. import symbol as sym
+from .recipe import low_precision_io
 
 vgg_spec = {
     11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
@@ -32,7 +33,7 @@ def get_feature(internel_layer, layers, filters, batch_norm=False):
     return internel_layer
 
 
-def get_classifier(input_data, num_classes):
+def get_classifier(input_data, num_classes, dtype="float32"):
     flatten = sym.Flatten(input_data, name="flatten")
     fc6 = sym.FullyConnected(flatten, num_hidden=4096, name="fc6")
     relu6 = sym.Activation(fc6, act_type="relu", name="relu6")
@@ -40,15 +41,18 @@ def get_classifier(input_data, num_classes):
     fc7 = sym.FullyConnected(drop6, num_hidden=4096, name="fc7")
     relu7 = sym.Activation(fc7, act_type="relu", name="relu7")
     drop7 = sym.Dropout(relu7, p=0.5, name="drop7")
+    drop7 = low_precision_io(drop7, dtype, out=True)
     fc8 = sym.FullyConnected(drop7, num_hidden=num_classes, name="fc8")
     return fc8
 
 
-def get_symbol(num_classes=1000, num_layers=16, batch_norm=False, **kwargs):
+def get_symbol(num_classes=1000, num_layers=16, batch_norm=False,
+               dtype="float32", **kwargs):
     if num_layers not in vgg_spec:
         raise ValueError(f"no experiments done on num_layers {num_layers}")
     layers, filters = vgg_spec[num_layers]
     data = sym.Variable(name="data")
+    data = low_precision_io(data, dtype)
     feature = get_feature(data, layers, filters, batch_norm)
-    classifier = get_classifier(feature, num_classes)
+    classifier = get_classifier(feature, num_classes, dtype)
     return sym.SoftmaxOutput(classifier, name="softmax")

@@ -4,5 +4,6 @@ from .base_module import BaseModule
 from .executor_group import DataParallelExecutorGroup
 from .module import Module
 from .bucketing_module import BucketingModule
+from .gan_module import GANModule
 from .sequential_module import SequentialModule
 from .python_module import PythonModule, PythonLossModule

@@ -21,7 +21,7 @@ from ..base import MXNetError
 from ..initializer import Uniform
 from .. import telemetry as _tm
 from .base_module import BaseModule, _check_input_names
-from .module import Module
+from .module import Module, WindowBoundary
 
 
 class BucketingModule(BaseModule):
@@ -273,14 +273,80 @@ class BucketingModule(BaseModule):
             compiled = [warm(mod) for _key, mod in items]
         return {key: kinds for (key, _mod), kinds in zip(items, compiled)}
 
+    @property
+    def input_shardings(self):
+        """Input placements of the ACTIVE bucket. All buckets bind the same
+        devices/mesh and the same input names (only shapes differ per
+        bucket), so the current module's map is valid for every staged
+        batch — this is what lets ``DevicePrefetchIter`` stage bucketed
+        batches ahead exactly like ``Module.fit``'s pipeline."""
+        if not self.binded:
+            return None
+        return self._curr_module.input_shardings
+
     def prepare(self, data_batch):
         """Pre-bind the batch's bucket without making it current (the
-        prefetch path warms the program for batch N+1 this way)."""
+        prefetch path warms the program for batch N+1 this way) and stage
+        the batch's arrays onto the device with that bucket's shardings."""
         self._require(bound=True, params=True)
         active = self._curr_bucket_key
         self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
                            data_batch.provide_label)
+        self._curr_module.prepare(data_batch)
         self.switch_bucket(active, None, None)
+
+    def train_window(self, data_batch, n_steps=1, batches=None,
+                     publish_grads=True):
+        """Fused K-step windows for bucketed training.
+
+        A chunk of batches is grouped by ``bucket_key`` (stable order) and
+        each group dispatches through its bucket Module's
+        :meth:`Module.train_window` — one fused, donated XLA program per
+        ``(bucket, group size)`` pair, all sharing parameters, optimizer
+        state and the AOT cache through the ``shared_module`` machinery.
+        After one pass over the bucket set the fused programs are all
+        cached, so steady-state training issues ZERO compiles and zero
+        per-batch host syncs: ``switch_bucket`` is a pure cache pick.
+
+        The group containing the chunk's LAST batch dispatches last, so
+        ``fit``'s window-granular ``update_metric(eval_metric,
+        chunk[-1].label)`` reads the matching bucket's outputs. Returns a
+        combined :class:`WindowBoundary` covering every group (its
+        ``wait()`` fences the whole chunk); gradients, when published,
+        are the final group's — the chunk-end values a deferred reader
+        expects.
+        """
+        self._require(bound=True, params=True, optimizer=True)
+        if batches is None:
+            self.switch_bucket(data_batch.bucket_key,
+                               data_batch.provide_data,
+                               data_batch.provide_label)
+            self._params_dirty = True
+            _tm.counter("bucketing.window").inc()
+            return self._curr_module.train_window(
+                data_batch, n_steps=n_steps, publish_grads=publish_grads)
+        if not batches:
+            return None
+        groups = {}
+        for b in batches:
+            groups.setdefault(b.bucket_key, []).append(b)
+        last_key = batches[-1].bucket_key
+        keys = [k for k in groups if k != last_key] + [last_key]
+        total, outs, boundary = 0, [], None
+        for key in keys:
+            grp = groups[key]
+            self.switch_bucket(key, grp[0].provide_data,
+                               grp[0].provide_label)
+            _tm.counter("bucketing.window").inc()
+            boundary = self._curr_module.train_window(
+                None, batches=grp, publish_grads=publish_grads)
+            total += boundary.n_steps
+            outs.extend(boundary._outs)
+        self._params_dirty = True
+        if len(keys) == 1:
+            return boundary
+        return WindowBoundary(total, outs,
+                              boundary._grads if publish_grads else None)
 
     def forward(self, data_batch, is_train=None):
         self._require(bound=True, params=True)

@@ -1,0 +1,137 @@
+"""tools/bench_compare.py: the CI regression gate over bench JSON records.
+
+The gate is load-bearing for the whole-zoo scoreboard — a silent false
+pass would let a throughput regression ship — so both directions are
+pinned: regressions past the threshold exit 1 and name the metric, clean
+comparisons exit 0, and the zero-compile invariant (steady_compiles
+0 -> N) is an unbounded lower-is-better regression no threshold can
+absorb.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import bench_compare  # noqa: E402
+
+_BASE = {
+    "metric": "whole_zoo_suite",
+    "value": 100.0,
+    "unit": "geomean train samples/sec",
+    "workloads": {
+        "mlp": {"train_samples_per_sec": 5000.0,
+                "infer_samples_per_sec": 20000.0,
+                "steady_compiles": 0, "train_outputs_finite": True,
+                "dtype": "float32", "window_k": 2},
+        "dcgan": {"train_samples_per_sec": 100.0, "fused_speedup": 1.5,
+                  "steady_compiles": 0, "mfu_train": 0.41},
+    },
+}
+
+
+def _write(tmp_path, name, record, preamble=()):
+    path = tmp_path / name
+    lines = list(preamble) + [json.dumps(record)]
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def _clone(**edits):
+    rec = json.loads(json.dumps(_BASE))
+    for dotted, val in edits.items():
+        node = rec
+        parts = dotted.split(".")
+        for p in parts[:-1]:
+            node = node[p]
+        node[parts[-1]] = val
+    return rec
+
+
+def test_identical_records_pass(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", _BASE)
+    assert bench_compare.main([base, base]) == 0
+    out = capsys.readouterr().out
+    assert "OK" in out and "REGRESSION" not in out
+
+
+def test_throughput_regression_fails_and_names_metric(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", _BASE)
+    slow = _write(tmp_path, "new.json",
+                  _clone(**{"workloads.mlp.train_samples_per_sec": 4000.0}))
+    assert bench_compare.main([base, slow]) == 1
+    out = capsys.readouterr().out
+    assert "workloads.mlp.train_samples_per_sec" in out
+    assert "REGRESSION" in out and "FAIL" in out
+
+
+def test_regression_within_threshold_passes(tmp_path):
+    base = _write(tmp_path, "base.json", _BASE)
+    slow = _write(tmp_path, "new.json",
+                  _clone(**{"workloads.mlp.train_samples_per_sec": 4800.0}))
+    assert bench_compare.main([base, slow]) == 0  # -4% < default 5%
+    assert bench_compare.main([base, slow, "--threshold", "3"]) == 1
+
+
+def test_steady_compiles_zero_to_one_is_unbounded_regression(tmp_path,
+                                                             capsys):
+    """The zero-recompile invariant: 0 -> 1 has no percent representation
+    a threshold could excuse — it must fail at ANY threshold."""
+    base = _write(tmp_path, "base.json", _BASE)
+    recompiling = _write(tmp_path, "new.json",
+                         _clone(**{"workloads.dcgan.steady_compiles": 1}))
+    assert bench_compare.main(
+        [base, recompiling, "--threshold", "10000"]) == 1
+    assert "workloads.dcgan.steady_compiles" in capsys.readouterr().out
+
+
+def test_improvements_and_added_fields_never_gate(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", _BASE)
+    better = _clone(**{"workloads.mlp.train_samples_per_sec": 9000.0,
+                       "workloads.dcgan.fused_speedup": 2.0})
+    better["workloads"]["lenet"] = {"train_samples_per_sec": 100.0}
+    new = _write(tmp_path, "new.json", better)
+    assert bench_compare.main([base, new]) == 0
+    out = capsys.readouterr().out
+    assert "added:" in out  # visible, but not a failure
+
+
+def test_explicit_metrics_restrict_the_gate(tmp_path):
+    base = _write(tmp_path, "base.json", _BASE)
+    # mlp regressed badly, but the explicit gate only watches dcgan
+    new = _write(tmp_path, "new.json",
+                 _clone(**{"workloads.mlp.train_samples_per_sec": 1.0}))
+    assert bench_compare.main(
+        [base, new, "--metrics",
+         "workloads.dcgan.train_samples_per_sec,value"]) == 0
+    assert bench_compare.main(
+        [base, new, "--metrics",
+         "workloads.mlp.train_samples_per_sec"]) == 1
+
+
+def test_explicit_metric_missing_from_either_record_is_an_error(tmp_path):
+    base = _write(tmp_path, "base.json", _BASE)
+    with pytest.raises(SystemExit):
+        bench_compare.main([base, base, "--metrics", "workloads.gone.rate"])
+
+
+def test_last_json_line_wins_over_driver_noise(tmp_path):
+    """A bench log may carry progress lines and stale records; the LAST
+    JSON object line is the record (bench.py's output contract)."""
+    stale = json.dumps({"value": 1.0})
+    base = _write(tmp_path, "base.json", _BASE,
+                  preamble=["suite: mlp ...", stale, "not json {"])
+    rec = bench_compare.load_record(base)
+    assert rec["value"] == _BASE["value"]
+
+
+def test_lower_better_flag_inverts_direction(tmp_path):
+    base = _write(tmp_path, "base.json", _clone(value=100.0))
+    higher = _write(tmp_path, "new.json", _clone(value=150.0))
+    assert bench_compare.main([base, higher]) == 0
+    assert bench_compare.main(
+        [base, higher, "--metrics", "value", "--lower-better", "value"]) == 1

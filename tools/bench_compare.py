@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Diff two bench JSON records and gate on throughput regressions.
+
+The CI answer to "did this PR slow anything down?": bench.py (any
+BENCH_MODE, including the whole-zoo ``suite`` scoreboard) prints one JSON
+line; save the line from the base revision and the candidate, then:
+
+  python tools/bench_compare.py base.json new.json --threshold 5
+
+exits 1 when any gated metric regressed by more than the threshold
+percent. Stdlib only — usable from any CI image that can run python.
+
+Metric selection
+----------------
+By default every numeric field that is throughput-shaped is gated,
+discovered by walking both records and matching leaf names:
+
+* higher-is-better: ``value``, ``*_per_sec``, ``mfu*``, ``vs_baseline``,
+  ``fused_speedup``, ``availability``, ``replica_scaling``,
+  ``group_scaling_4x`` — regression = new < base.
+* lower-is-better: ``steady_compiles`` (the zero-recompile invariant:
+  ANY increase past the threshold fails), plus any path named via
+  ``--lower-better``.
+
+``--metrics workloads.dcgan.train_samples_per_sec,value`` restricts the
+gate to explicit dotted paths (a path missing from either record is an
+error — a silently vanished metric must not pass). Fields present in only
+one record are reported as added/removed but never gate, so a bench
+record can grow new fields without breaking older baselines.
+
+A bench file may hold whole driver output; the LAST line that parses as a
+JSON object is the record (bench.py's output contract).
+"""
+
+import argparse
+import json
+import sys
+
+_HIGHER_LEAVES = ("value", "vs_baseline", "fused_speedup", "availability",
+                  "replica_scaling", "group_scaling_4x")
+_HIGHER_PREFIXES = ("mfu",)
+_HIGHER_SUFFIXES = ("_per_sec",)
+_LOWER_LEAVES = ("steady_compiles",)
+
+
+def load_record(path):
+    """Last JSON-object line of the file — bench.py prints exactly one."""
+    record = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict):
+                record = parsed
+    if record is None:
+        raise SystemExit(f"{path}: no JSON record line found")
+    return record
+
+
+def walk(obj, prefix=""):
+    """Yield (dotted_path, number) for every numeric leaf."""
+    if isinstance(obj, dict):
+        for key, val in obj.items():
+            yield from walk(val, f"{prefix}.{key}" if prefix else key)
+    elif isinstance(obj, bool):
+        return
+    elif isinstance(obj, (int, float)):
+        yield prefix, float(obj)
+
+
+def lookup(record, path):
+    node = record
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    if isinstance(node, bool) or not isinstance(node, (int, float)):
+        return None
+    return float(node)
+
+
+def classify(path):
+    """'higher', 'lower' or None (not gated by default)."""
+    leaf = path.rsplit(".", 1)[-1]
+    if leaf in _LOWER_LEAVES:
+        return "lower"
+    if (leaf in _HIGHER_LEAVES or leaf.endswith(_HIGHER_SUFFIXES)
+            or leaf.startswith(_HIGHER_PREFIXES)):
+        return "higher"
+    return None
+
+
+def compare(base, new, threshold, metrics=None, lower_better=()):
+    """Returns (rows, regressions, notes). Each row is
+    (path, base, new, delta_pct, direction)."""
+    base_paths = dict(walk(base))
+    new_paths = dict(walk(new))
+    if metrics:
+        gated = []
+        for path in metrics:
+            if lookup(base, path) is None or lookup(new, path) is None:
+                raise SystemExit(f"--metrics {path}: not a numeric field of "
+                                 f"both records")
+            gated.append(path)
+    else:
+        gated = sorted(p for p in base_paths
+                       if p in new_paths and classify(p) is not None)
+    rows, regressions = [], []
+    for path in gated:
+        b, n = lookup(base, path), lookup(new, path)
+        direction = ("lower" if path in lower_better
+                     else classify(path) or "higher")
+        if b == 0.0:
+            # zero base: any increase of a lower-is-better metric (e.g.
+            # steady_compiles 0 -> 1) is an unbounded regression
+            delta = 0.0 if n == b else float("inf")
+            regressed = direction == "lower" and n > b
+        else:
+            delta = (n - b) / abs(b) * 100.0
+            regressed = (delta < -threshold if direction == "higher"
+                         else delta > threshold)
+        rows.append((path, b, n, delta, direction))
+        if regressed:
+            regressions.append(path)
+    notes = []
+    only_base = sorted(set(base_paths) - set(new_paths))
+    only_new = sorted(set(new_paths) - set(base_paths))
+    if only_base:
+        notes.append(f"removed: {', '.join(only_base[:8])}"
+                     + (" ..." if len(only_base) > 8 else ""))
+    if only_new:
+        notes.append(f"added: {', '.join(only_new[:8])}"
+                     + (" ..." if len(only_new) > 8 else ""))
+    return rows, regressions, notes
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="gate bench JSON records on throughput regressions")
+    parser.add_argument("base", help="bench output at the base revision")
+    parser.add_argument("new", help="bench output at the candidate revision")
+    parser.add_argument("--threshold", type=float, default=5.0,
+                        help="max tolerated regression, percent (default 5)")
+    parser.add_argument("--metrics", type=str, default=None,
+                        help="comma-separated dotted paths to gate "
+                             "(default: auto-discover throughput fields)")
+    parser.add_argument("--lower-better", type=str, default="",
+                        help="comma-separated dotted paths where an "
+                             "INCREASE is the regression")
+    args = parser.parse_args(argv)
+
+    metrics = ([m.strip() for m in args.metrics.split(",") if m.strip()]
+               if args.metrics else None)
+    lower = tuple(m.strip() for m in args.lower_better.split(",")
+                  if m.strip())
+    rows, regressions, notes = compare(
+        load_record(args.base), load_record(args.new), args.threshold,
+        metrics=metrics, lower_better=lower)
+
+    if not rows:
+        raise SystemExit("no comparable metrics between the two records")
+    width = max(len(r[0]) for r in rows)
+    for path, b, n, delta, direction in rows:
+        flag = " <-- REGRESSION" if path in regressions else ""
+        arrow = "v" if direction == "lower" else "^"
+        print(f"{path:<{width}}  {b:>12.3f} -> {n:>12.3f}  "
+              f"{delta:>+8.2f}% ({arrow}){flag}")
+    for note in notes:
+        print(note)
+    if regressions:
+        print(f"FAIL: {len(regressions)} metric(s) regressed more than "
+              f"{args.threshold}%: {', '.join(regressions)}")
+        return 1
+    print(f"OK: {len(rows)} metric(s) within {args.threshold}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
