@@ -120,6 +120,16 @@ per-save ``snapshot_us`` / ``write_us`` / ``write_async_us``, the
 resulting ``pause_us`` each mode charges the training loop, and
 ``async_vs_sync_pause`` (the bounded-stall win; ``BENCH_CKPT_EPOCHS``
 sizes the pass).
+
+``BENCH_MODE=io`` measures the INPUT PLANE alone: ImageRecordIter
+decode+augment img/s over a generated synthetic-JPEG ``.rec``, serial
+baseline vs the supervised decode pool at each ``BENCH_IO_WORKERS``
+count. The record carries the full ``scaling`` curve, the gated
+``pool_speedup`` ratio, and the ``io.plane.*`` telemetry snapshot.
+``BENCH_FIT_DATA=recordio`` makes the fit mode train ResNet from a
+generated RecordIO file end-to-end (metric suffix ``_recordio``) — the
+number that proves the plane feeds the chip at device rate. See
+docs/io.md.
 """
 # graftlint: allow=env-registry(bench drives the framework's declared MXNET_* knobs and chaos injection by writing/restoring os.environ by design — the sweep and chaos legs ARE env manipulation)
 
@@ -152,16 +162,54 @@ def _build_module(mx, models, batch_size, image, dtype, num_layers, on_tpu):
     return mod
 
 
-def _run_fit_mode(mx, mod, batch_size, image, dtype, iters, windows):
-    """Time Module.fit epochs over a real NDArrayIter (+Accuracy metric)."""
-    rng = np.random.RandomState(0)
-    n = batch_size * iters
-    # cast to the BOUND dtype up front (bfloat16 on TPU): the executor was
-    # compiled for it, and staging f32 would double the H2D bytes
-    data = rng.uniform(-1, 1, (n,) + image).astype(mx.base.np_dtype(dtype))
-    label = rng.randint(0, 1000, (n,)).astype(np.float32)
-    train = mx.io.NDArrayIter(data, label, batch_size=batch_size,
-                              last_batch_handle="discard")
+def _write_bench_rec(mx, path, n, image, seed=0):
+    """Synthetic-JPEG RecordIO fixture for the io/recordio bench legs:
+    ``n`` random images a shade larger than ``image`` (so rand_crop has
+    room), labels = record id % 1000."""
+    from mxnet_tpu import recordio
+
+    rng = np.random.RandomState(seed)
+    side = image[1] + max(8, image[1] // 8)
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (side, side, 3), np.uint8)
+        rec.write(recordio.pack_img((0, float(i % 1000), i, 0), img))
+    rec.close()
+    return path
+
+
+def _recordio_fit_iter(mx, batch_size, image, iters, windows):
+    """BENCH_FIT_DATA=recordio: an ImageRecordIter over a generated .rec
+    holding exactly the samples one epoch consumes — the leg that proves
+    the decode plane feeds the chip at the synthetic-data rate."""
+    import tempfile
+
+    td = tempfile.mkdtemp(prefix="bench_recordio_")
+    path = _write_bench_rec(mx, os.path.join(td, "train.rec"),
+                            batch_size * iters, image)
+    workers = int(os.environ.get("BENCH_IO_WORKERS_FIT", 4))
+    return mx.io.ImageRecordIter(
+        path_imgrec=path, data_shape=image, batch_size=batch_size,
+        rand_crop=True, rand_mirror=True, shuffle=True, seed=0,
+        preprocess_threads=workers)
+
+
+def _run_fit_mode(mx, mod, batch_size, image, dtype, iters, windows,
+                  fit_data="synthetic"):
+    """Time Module.fit epochs over a real data iterator (+Accuracy
+    metric): an in-memory NDArrayIter by default, or the RecordIO decode
+    plane when ``fit_data == "recordio"``."""
+    if fit_data == "recordio":
+        train = _recordio_fit_iter(mx, batch_size, image, iters, windows)
+    else:
+        rng = np.random.RandomState(0)
+        n = batch_size * iters
+        # cast to the BOUND dtype up front (bfloat16 on TPU): the executor
+        # was compiled for it, and staging f32 would double the H2D bytes
+        data = rng.uniform(-1, 1, (n,) + image).astype(mx.base.np_dtype(dtype))
+        label = rng.randint(0, 1000, (n,)).astype(np.float32)
+        train = mx.io.NDArrayIter(data, label, batch_size=batch_size,
+                                  last_batch_handle="discard")
     marks = []
 
     def epoch_mark(epoch, sym=None, arg=None, aux=None):
@@ -1180,6 +1228,70 @@ def _run_score_mode(mx, models, jax, on_tpu):
     print(json.dumps(record))
 
 
+# ---------------------------------------------------------------------------
+# BENCH_MODE=io — the decode plane alone: img/s vs worker count. The
+# scaling curve is the tentpole evidence that the parallel pool can feed
+# the chip at device rate; serial (use_pool=0) is the baseline.
+# ---------------------------------------------------------------------------
+def _run_io_mode(mx, on_tpu):
+    """BENCH_MODE=io: ImageRecordIter decode+augment throughput, serial
+    vs pooled at 1/2/4/... workers, over a generated synthetic-JPEG .rec.
+    Emits one JSON record: value = best pooled img/s, pool_speedup =
+    best/serial (the gated ratio), scaling = the full curve."""
+    import tempfile
+
+    image = (3, 224, 224) if on_tpu else (3, 48, 48)
+    batch_size = int(os.environ.get("BENCH_IO_BATCH", 32 if on_tpu else 16))
+    records = int(os.environ.get("BENCH_IO_RECORDS",
+                                 2048 if on_tpu else 320))
+    passes = int(os.environ.get("BENCH_IO_PASSES", 2))
+    workers = [int(w) for w in os.environ.get(
+        "BENCH_IO_WORKERS", "1,2,4,8" if on_tpu else "1,2,4").split(",")]
+    td = tempfile.mkdtemp(prefix="bench_io_")
+    path = _write_bench_rec(mx, os.path.join(td, "bench.rec"), records, image)
+
+    def rate(**kw):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=path, data_shape=image, batch_size=batch_size,
+            rand_crop=True, rand_mirror=True, shuffle=True, seed=0, **kw)
+        for _ in it:       # warm epoch: readers, pool spin-up, page cache
+            pass
+        best = 0.0
+        for _ in range(passes):
+            it.reset()
+            n, tic = 0, time.time()
+            for _ in it:
+                n += batch_size
+            best = max(best, n / (time.time() - tic))
+        it.close()
+        return best
+
+    mx.telemetry.reset()
+    serial = rate(use_pool=False, preprocess_threads=1)
+    scaling, best, best_workers = {}, 0.0, workers[0]
+    for w in workers:
+        r = rate(use_pool=True, preprocess_threads=w)
+        scaling[str(w)] = round(r, 2)
+        if r > best:
+            best, best_workers = r, w
+    from mxnet_tpu import native as _native
+
+    record = {
+        "metric": "io_plane_decode" + ("" if on_tpu else "_cpusmoke"),
+        "value": round(best, 2),
+        "unit": "images/sec",
+        "serial_img_per_sec": round(serial, 2),
+        "pool_speedup": round(best / serial, 3) if serial else 0.0,
+        "workers_best": best_workers,
+        "scaling": scaling,
+        "records": records,
+        "native_plane": bool(_native.available()),
+        "cpu_count": os.cpu_count(),
+        "telemetry": mx.telemetry.snapshot(),
+    }
+    print(json.dumps(record))
+
+
 def main():
     import jax
 
@@ -1215,6 +1327,10 @@ def main():
                        on_tpu)
         return
 
+    if mode == "io":
+        _run_io_mode(mx, on_tpu)
+        return
+
     sweep = None
     if mode == "fit":
         # the real training loop defaults to the framework's intended
@@ -1242,12 +1358,16 @@ def main():
         # _run_fit_mode resets telemetry again at the first epoch boundary
         # so the snapshot covers the steady-state epochs only
         mx.telemetry.reset()
+        fit_data = os.environ.get("BENCH_FIT_DATA", "synthetic")
         img_per_sec, spread, cold_compile_s = _run_fit_mode(
-            mx, mod, batch_size, image, dtype, max(iters, 2), max(windows, 2))
+            mx, mod, batch_size, image, dtype, max(iters, 2), max(windows, 2),
+            fit_data=fit_data)
         snapshot = mx.telemetry.snapshot()
         record = {
             "metric": f"resnet{num_layers}_fit_throughput"
+                      + ("_recordio" if fit_data == "recordio" else "")
                       + ("" if on_tpu else "_cpusmoke"),
+            "fit_data": fit_data,
             "value": round(img_per_sec, 2),
             "unit": "images/sec",
             "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),

@@ -200,6 +200,25 @@ _declare("MXNET_IO_RETRY", int, 0,
 _declare("MXNET_IO_RETRY_BACKOFF", float, 0.05,
          "Initial backoff seconds for io.RetryingIter; doubles per "
          "attempt, capped at 30 s.")
+_declare("MXNET_IO_POOL", _parse_bool, True,
+         "Decode RecordIO batches through the supervised parallel pool "
+         "(io_plane.DecodePool): ImageRecordIter/ImageDetRecordIter fan "
+         "decode+augment over preprocess_threads workers behind an "
+         "ordered reorder buffer that keeps the batch stream "
+         "byte-identical to the serial path at a fixed seed. 0 restores "
+         "the single-consumer serial decode path (also per-iterator via "
+         "use_pool=False).")
+_declare("MXNET_IO_QUEUE_DEPTH", int, 0,
+         "Bound on decoded-but-unconsumed batches buffered by the decode "
+         "pool's reorder buffer (backpressure: workers pause decoding "
+         "rather than grow memory). 0 (default) = max(4, "
+         "2*preprocess_threads).")
+_declare("MXNET_IO_WORKER_TIMEOUT_MS", float, 60000.0,
+         "Hung-decode watchdog: when the batch the consumer needs has "
+         "been decoding on one worker longer than this, the worker is "
+         "abandoned (telemetry io.plane.worker_stall) and its shard "
+         "reassigned to a fresh worker (io.plane.worker_restart). 0 "
+         "disables the watchdog.")
 _declare("MXNET_KV_TIMEOUT", float, 0.0,
          "Seconds a dist kvstore barrier may block before the process "
          "logs actionable diagnostics (rank, peers, likely dead-node "
@@ -336,6 +355,21 @@ _declare("MXNET_FI_SERVE_RELOAD_CORRUPT", str, "",
          "whose hot reload raises mid-swap — the server must eject that "
          "replica (serving.replica.ejected) and keep the pool serving "
          "the new weights on the others.")
+_declare("MXNET_FI_IO_CRASH_BATCHES", str, "",
+         "Fault injection (decode-pool chaos): comma-separated batch "
+         "ordinals whose decode raises a non-data error inside the pool "
+         "worker, killing that worker thread — the supervisor must "
+         "restart the slot and reassign its shard with no lost or "
+         "duplicated records. Fires once per ordinal "
+         "(telemetry faultinject.io_crash).")
+_declare("MXNET_FI_IO_HANG_BATCHES", str, "",
+         "Fault injection (decode-pool chaos): comma-separated batch "
+         "ordinals whose decode sleeps MXNET_FI_IO_HANG_MS inside the "
+         "pool worker — watchdog fuel for MXNET_IO_WORKER_TIMEOUT_MS. "
+         "Fires once per ordinal (telemetry faultinject.io_hang).")
+_declare("MXNET_FI_IO_HANG_MS", float, 500.0,
+         "Duration of the injected decode hang "
+         "(MXNET_FI_IO_HANG_BATCHES).")
 _declare("MXNET_SERVING_MESH", str, "auto",
          "Per-replica device-group layout for serving.ModelServer: a "
          "GraftMesh spec for ONE replica's sub-mesh (axis tokens like "

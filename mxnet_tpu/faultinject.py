@@ -40,6 +40,20 @@ fault without patching framework code:
                                 (default 17).
 ==============================  =============================================
 
+Decode-pool faults (chaos harness for ``mxnet_tpu/io_plane``; separate
+gate like the serving faults, same attempt/rank scoping):
+
+==================================  =========================================
+``MXNET_FI_IO_CRASH_BATCHES``       comma-separated batch ordinals whose
+                                    decode raises a non-data error inside
+                                    the pool worker ONCE — kills that worker
+                                    thread, driving supervisor restart +
+                                    shard reassignment.
+``MXNET_FI_IO_HANG_BATCHES``        batch ordinals whose decode sleeps
+                                    ``MXNET_FI_IO_HANG_MS`` ONCE — watchdog
+                                    fuel for ``MXNET_IO_WORKER_TIMEOUT_MS``.
+==================================  =========================================
+
 Serving-path faults (the chaos harness for ``mxnet_tpu/serving``; same
 ``MXNET_FI_ATTEMPT``/``MXNET_FI_RANK`` gating, read per call so a test —
 or ``bench.py BENCH_CHAOS=1`` — can kill and revive a replica at runtime
@@ -83,6 +97,7 @@ from .io import DataIter
 _lock = threading.Lock()
 _batch_ordinal = -1  # process-global count of train batches seen by fit
 _serve_ordinal = 0   # process-global count of serving batch attempts
+_io_fired = set()    # (kind, ordinal) decode-pool injections already fired
 
 
 def _csv_ints(name):
@@ -128,6 +143,7 @@ def reset():
     with _lock:
         _batch_ordinal = -1
         _serve_ordinal = 0
+        _io_fired.clear()
 
 
 def on_train_batch(data_batch):
@@ -171,6 +187,48 @@ def _poison_batch(data_batch):
     data_batch.data = poisoned
     data_batch.staged = False  # re-stage: the arrays are new
     return data_batch
+
+
+def io_plane_active():
+    """True when any decode-pool fault is configured for THIS launcher
+    attempt+rank (separate from :func:`active` — io chaos must not flip
+    fit's window-fusion opt-out)."""
+    if not any(_env.raw(k) for k in (
+            "MXNET_FI_IO_CRASH_BATCHES", "MXNET_FI_IO_HANG_BATCHES")):
+        return False
+    return _attempt_matches() and _rank_matches()
+
+
+def _io_fire_once(kind, ordinal):
+    """(decode-pool) True the first time this (kind, ordinal) fires."""
+    with _lock:
+        if (kind, ordinal) in _io_fired:
+            return False
+        _io_fired.add((kind, ordinal))
+        return True
+
+
+def on_io_decode(ordinal):
+    """Hook at the top of every decode-pool worker task (``ordinal`` =
+    batch ordinal within the epoch). May sleep (hung worker — watchdog
+    fuel) or raise a non-:class:`MXNetError` (worker death — supervisor
+    restart fuel). Each injection fires ONCE per ordinal so the retried
+    decode after reassignment succeeds and the epoch completes."""
+    if not io_plane_active():
+        return
+    if ordinal in _csv_ints("MXNET_FI_IO_HANG_BATCHES") \
+            and _io_fire_once("hang", ordinal):
+        _tm.counter("faultinject.io_hang").inc()
+        import time
+
+        time.sleep(_env.get("MXNET_FI_IO_HANG_MS") / 1e3)
+    if ordinal in _csv_ints("MXNET_FI_IO_CRASH_BATCHES") \
+            and _io_fire_once("crash", ordinal):
+        _tm.counter("faultinject.io_crash").inc()
+        # deliberately NOT MXNetError: a data error is delivered in
+        # order; this models the worker itself dying
+        raise RuntimeError(
+            f"faultinject: injected decode-worker crash at batch {ordinal}")
 
 
 def serving_active():

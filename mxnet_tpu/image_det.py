@@ -15,8 +15,10 @@ IOU-constrained crop (sampler list with min/max scale, aspect ratio and
 overlap, ``image_det_aug_default.cc`` RandomCropGenerator), random
 expansion pad, mirror (x-coords flipped), and force-resize to
 ``data_shape`` — each transform updates box coordinates consistently.
-The decode/augment work runs in a host thread pool; the TPU only ever
-sees the final packed batch.
+Decode/augment fans out over the same supervised
+:class:`mxnet_tpu.io_plane.DecodePool` as ``ImageRecordIter`` (see
+``docs/io.md``), byte-identical to the serial path at a fixed seed; the
+TPU only ever sees the final packed batch.
 """
 
 from __future__ import annotations
@@ -26,7 +28,9 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from . import telemetry as _telemetry
 from .base import MXNetError
+from .io_plane import DecodePool, input_split
 from .recordio import MXRecordIO, unpack
 
 _PAD = -1.0
@@ -197,7 +201,8 @@ class ImageDetRecordIter:
                  shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
                  part_index=0, num_parts=1, preprocess_threads=None, seed=0,
-                 data_name="data", label_name="label", **aug_kwargs):
+                 data_name="data", label_name="label", use_pool=None,
+                 **aug_kwargs):
         import cv2  # noqa: F401 — fail early if decode backend missing
 
         self.data_shape = tuple(data_shape)
@@ -214,7 +219,9 @@ class ImageDetRecordIter:
 
         if preprocess_threads is None:
             preprocess_threads = _env.get("MXNET_CPU_WORKER_NTHREADS")
-        self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+        self._threads = preprocess_threads
+        # serial-path executor, created lazily on first _fetch
+        self._pool = None
         self._lock = threading.Lock()
 
         # scan offsets + find max object count / object width for padding
@@ -236,9 +243,19 @@ class ImageDetRecordIter:
         self.obj_width = obj_width
         self.max_objs = max(max_objs, label_pad_width // obj_width if
                             label_pad_width else 0, 1)
-        self._offsets = self._offsets[part_index::num_parts]
+        # same InputSplit helper as ImageRecordIter and the pool's
+        # per-worker shard split
+        self._offsets = input_split(self._offsets, part_index, num_parts)
         self._rec = MXRecordIO(path_imgrec, "r")
         self._order = np.arange(len(self._offsets))
+        self.path_imgrec = path_imgrec
+        if use_pool is None:
+            use_pool = bool(_env.get("MXNET_IO_POOL"))
+        self._dpool = None
+        if use_pool:
+            self._dpool = DecodePool(
+                self._decode_batch, self._threads,
+                worker_state=lambda: MXRecordIO(self.path_imgrec, "r"))
         self.reset()
 
     @property
@@ -259,16 +276,44 @@ class ImageDetRecordIter:
         if self.shuffle:
             self.rs.shuffle(self._order)
         self._cursor = 0
+        if self._dpool is not None:
+            self._start_pooled_epoch()
+
+    def _start_pooled_epoch(self):
+        """Fix batch order and per-batch seeds on the coordinator, in
+        batch order — identical RNG consumption to the serial path's
+        lazy draws, which is the byte-parity contract."""
+        size = self.batch_size
+        payloads = []
+        for start in range(0, len(self._order) - size + 1, size):
+            payloads.append((np.array(self._order[start:start + size]),
+                             self.rs.randint(0, 2 ** 31 - 1, size=size)))
+        self._dpool.start_epoch(payloads)
+
+    def close(self):
+        """Stop the decode-pool workers (idempotent)."""
+        if getattr(self, "_dpool", None) is not None:
+            self._dpool.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __iter__(self):
         return self
 
-    def _load_one(self, offset, seed):
+    def _load_one(self, offset, seed, rec=None):
         import cv2
 
-        with self._lock:
-            self._rec.handle.seek(offset)
-            buf = self._rec.read()
+        if rec is not None:  # pool worker's private reader: lock-free
+            rec.seek(offset)
+            buf = rec.read()
+        else:
+            with self._lock:
+                self._rec.handle.seek(offset)
+                buf = self._rec.read()
         header, img_buf = unpack(buf)
         img = cv2.imdecode(np.frombuffer(img_buf, np.uint8), cv2.IMREAD_COLOR)
         img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
@@ -285,15 +330,30 @@ class ImageDetRecordIter:
             padded[:n] = boxes[:n]
         return arr, padded
 
-    def _fetch(self):
-        from .io import DataBatch
-        from .ndarray import array
+    def _decode_batch(self, payload, rec):
+        """DecodePool decode fn — pure function of the payload (batch
+        indices + coordinator-drawn per-record seeds) and the worker's
+        private reader."""
+        idxs, seeds = payload
+        results = [self._load_one(self._offsets[i], s, rec=rec)
+                   for i, s in zip(idxs, seeds)]
+        _telemetry.counter("io.plane.records").inc(len(idxs))
+        return (np.stack([r[0] for r in results]),
+                np.stack([r[1] for r in results]))
 
+    # graftlint: hotpath
+    def _fetch(self):
         n = len(self._order)
         if self._cursor + self.batch_size > n:
             raise StopIteration
+        if self._dpool is not None:
+            self._cursor += self.batch_size
+            data, label = self._dpool.next_result()
+            return self._batch_from_arrays(data, label)
         idxs = self._order[self._cursor:self._cursor + self.batch_size]
         self._cursor += self.batch_size
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=self._threads)
         seeds = self.rs.randint(0, 2 ** 31 - 1, size=len(idxs))
         results = list(
             self._pool.map(
@@ -301,8 +361,13 @@ class ImageDetRecordIter:
                 zip(idxs, seeds),
             )
         )
-        data = np.stack([r[0] for r in results])
-        label = np.stack([r[1] for r in results])
+        return self._batch_from_arrays(np.stack([r[0] for r in results]),
+                                       np.stack([r[1] for r in results]))
+
+    def _batch_from_arrays(self, data, label):
+        from .io import DataBatch
+        from .ndarray import array
+
         return DataBatch(
             data=[array(data)], label=[array(label)], pad=0, index=None,
             provide_data=self.provide_data, provide_label=self.provide_label,

@@ -802,7 +802,11 @@ class CSVIter(NDArrayIter):
 def ImageRecordIter(**kwargs):
     """RecordIO-backed image iterator (reference iter_image_recordio_2.cc).
 
-    Implemented over the recordio data plane; see mxnet_tpu.recordio.
+    Implemented over the recordio data plane: decode+augment fans out
+    over ``preprocess_threads`` supervised workers (io_plane.DecodePool,
+    gated by ``MXNET_IO_POOL``/``use_pool``) behind an ordered reorder
+    buffer, byte-identical to the serial path at a fixed seed. See
+    mxnet_tpu.recordio and docs/io.md.
     """
     from .recordio import ImageRecordIter as _Impl
 
@@ -811,7 +815,9 @@ def ImageRecordIter(**kwargs):
 
 def ImageDetRecordIter(**kwargs):
     """Detection-aware RecordIO iterator (reference
-    iter_image_det_recordio.cc:563); see mxnet_tpu.image_det."""
+    iter_image_det_recordio.cc:563), decoding through the same
+    supervised worker pool as ImageRecordIter; see mxnet_tpu.image_det
+    and docs/io.md."""
     from .image_det import ImageDetRecordIter as _Impl
 
     return _Impl(**kwargs)

@@ -1,0 +1,394 @@
+"""Parallel decode plane: a supervised worker pool with an ordered,
+bounded reorder buffer.
+
+This is the python-side counterpart of the reference framework's
+threaded ``ImageRecordIter`` pipeline (dmlc ``InputSplit`` +
+``ThreadedIter``): the *coordinator* (the iterator's ``reset()``)
+decides the epoch's batch order and per-batch RNG seeds up front, then
+hands the epoch to a :class:`DecodePool` whose workers each own a
+disjoint strided shard of batch ordinals (``input_split`` — the same
+helper that implements ``part_index/num_parts`` distributed sharding).
+Workers decode+augment concurrently and deliver into a reorder buffer;
+the consumer pops ordinals strictly in sequence, so the batch stream is
+byte-identical to the serial path regardless of worker count or
+scheduling.
+
+Design points
+-------------
+* **Determinism** lives entirely in the task payloads: shuffle and seed
+  draws happen on the coordinator before any worker runs, so workers
+  are pure functions of their payload.
+* **Backpressure**: a worker only starts decoding ordinal ``o`` once
+  ``o < next_to_consume + depth``, bounding buffered-but-undelivered
+  batches to ``depth`` (plus one in-flight batch per worker).
+* **Supervision**: a worker that dies (any non-:class:`MXNetError`
+  exception escaping decode) is reaped by the consumer — its remaining
+  ordinals, including the one it crashed on, move to a fresh worker in
+  the same slot (``io.plane.worker_crash`` / ``io.plane.worker_restart``).
+  A worker that *hangs* past ``MXNET_IO_WORKER_TIMEOUT_MS`` while the
+  consumer needs its ordinal is abandoned (``io.plane.worker_stall``)
+  and its shard reassigned the same way; a late result from the
+  abandoned thread is discarded by the first-store-wins buffer, so no
+  record is delivered twice. :class:`~mxnet_tpu.base.MXNetError` from
+  decode is a *data* error, not a worker fault: it is delivered in
+  order and re-raised to the caller exactly like the serial path.
+
+Fault injection (``MXNET_FI_IO_CRASH_BATCHES`` /
+``MXNET_FI_IO_HANG_BATCHES``) hooks in at the top of each decode via
+:func:`mxnet_tpu.faultinject.on_io_decode`.
+"""
+
+import threading
+import time
+import weakref
+from collections import deque
+
+from . import telemetry as _telemetry
+from .base import MXNetError
+
+__all__ = ["DecodePool", "input_split"]
+
+# consumer-wait slice (watchdog sampling period) and the idle-worker
+# park timeout; both are only safety nets — every state transition
+# notifies the consumer condition / sets the worker wakeup events
+_POLL_S = 0.2
+
+
+def input_split(seq, part_index, num_parts):
+    """Strided ``InputSplit``: the ``part_index``-th of ``num_parts``
+    disjoint shards of ``seq`` (``seq[part_index::num_parts]``).
+
+    One helper for every sharding decision in the IO plane: distributed
+    ``part_index/num_parts`` record sharding in ``ImageRecordIter`` /
+    ``ImageDetRecordIter`` (both the native-scan and python scan paths)
+    and the per-worker batch-ordinal split inside :class:`DecodePool`.
+    The shards of any ``seq`` form an exact disjoint cover of it.
+    """
+    num_parts = int(num_parts)
+    part_index = int(part_index)
+    if num_parts < 1:
+        raise MXNetError(f"num_parts must be >= 1, got {num_parts}")
+    if not 0 <= part_index < num_parts:
+        raise MXNetError(
+            f"part_index must be in [0, {num_parts}), got {part_index}")
+    return seq[part_index::num_parts]
+
+
+class _Worker(object):
+    """One pool slot: a daemon thread plus its strided ordinal queue."""
+
+    __slots__ = ("wid", "thread", "queue", "dead", "abandoned",
+                 "current", "started_at", "crashed", "blocked_since",
+                 "wakeup")
+
+    def __init__(self, wid):
+        self.wid = wid
+        self.thread = None
+        self.queue = deque()
+        self.dead = False        # thread exited after an unexpected error
+        self.abandoned = False   # watchdog gave up on it; exit when seen
+        self.current = None      # ordinal being decoded right now
+        self.started_at = 0.0    # monotonic time the current decode began
+        self.crashed = None      # (ordinal, exception) from a dying thread
+        self.blocked_since = None  # monotonic start of a backpressure block
+        # worker-owned (NOT pool-owned) idle signal: the thread must not
+        # hold any pool reference while parked, or the pool could never
+        # be garbage-collected (see _worker_loop)
+        self.wakeup = threading.Event()
+
+
+class DecodePool(object):
+    """Supervised decode pool delivering batches in coordinator order.
+
+    Parameters
+    ----------
+    decode : callable(payload, state) -> result
+        Pure decode function; must depend only on ``payload`` (and the
+        read-only ``state``) so retries and reassignment are safe.
+    num_workers : int
+        Pool size (``preprocess_threads``).
+    depth : int
+        Reorder-buffer bound; ``<= 0`` reads ``MXNET_IO_QUEUE_DEPTH``
+        (whose 0 default means ``max(4, 2 * num_workers)``).
+    worker_state : callable() -> object, optional
+        Per-worker state factory, run on the worker thread (e.g. each
+        worker opening its own ``MXRecordIO`` reader so decode never
+        serialises on a shared file handle).
+    timeout_ms : float, optional
+        Hung-worker watchdog; ``None`` reads
+        ``MXNET_IO_WORKER_TIMEOUT_MS``. 0 disables the watchdog.
+    """
+
+    _POLL_S = _POLL_S  # consumer-wait slice (watchdog sampling period)
+
+    def __init__(self, decode, num_workers, depth=0, worker_state=None,
+                 timeout_ms=None):
+        from . import env as _env
+        self._decode = decode
+        self._num_workers = max(1, int(num_workers))
+        depth = int(depth)
+        if depth <= 0:
+            depth = int(_env.get("MXNET_IO_QUEUE_DEPTH"))
+        if depth <= 0:
+            depth = max(4, 2 * self._num_workers)
+        self._depth = depth
+        if timeout_ms is None:
+            timeout_ms = float(_env.get("MXNET_IO_WORKER_TIMEOUT_MS"))
+        self._timeout_ms = float(timeout_ms)
+        self._state_factory = worker_state
+        self._cv = threading.Condition()
+        self._generation = 0
+        self._tasks = {}       # ordinal -> payload (current epoch)
+        self._results = {}     # ordinal -> (value, is_error)
+        self._attempts = {}    # ordinal -> times a worker claimed it
+        self._next = 0         # next ordinal the consumer will take
+        self._total = 0
+        self._closed = False
+        self._workers = [self._spawn(w) for w in range(self._num_workers)]
+        _telemetry.gauge("io.plane.workers").set(self._num_workers)
+
+    # ------------------------------------------------------------- epoch
+
+    def start_epoch(self, payloads):
+        """Install a new epoch: ``payloads[i]`` is batch ordinal ``i``.
+
+        Bumps the generation so any in-flight result from the previous
+        epoch is discarded, and deals each live worker its strided shard
+        of ordinals. Dead/abandoned slots left over from a previous
+        epoch are respawned here.
+        """
+        with self._cv:
+            self._generation += 1
+            self._tasks = dict(enumerate(payloads))
+            self._results.clear()
+            self._attempts.clear()
+            self._next = 0
+            self._total = len(self._tasks)
+            ordinals = list(range(self._total))
+            for i, worker in enumerate(self._workers):
+                if worker.dead or worker.abandoned:
+                    worker.abandoned = True  # tell a hung thread to exit
+                    self._workers[i] = self._spawn(worker.wid)
+                    _telemetry.counter("io.plane.worker_restart").inc()
+                self._workers[i].queue = deque(
+                    input_split(ordinals, i, self._num_workers))
+                self._workers[i].crashed = None
+            _telemetry.gauge("io.plane.queue_depth").set(0)
+            self._cv.notify_all()
+            self._wake_workers()
+
+    # graftlint: hotpath
+    def next_result(self):
+        """Pop the next batch in epoch order, supervising the pool.
+
+        Blocks until the ordinal is available, reaping crashed workers
+        and (when the watchdog is enabled) reassigning the shard of a
+        hung worker. Re-raises a stored decode :class:`MXNetError` in
+        order, exactly like the serial path would.
+        """
+        with _telemetry.span("io.plane.wait"):
+            with self._cv:
+                ordinal = self._next
+                if ordinal >= self._total:
+                    raise MXNetError("DecodePool: epoch exhausted")
+                waited_since = time.monotonic()
+                while ordinal not in self._results:
+                    if self._closed:
+                        raise MXNetError("DecodePool is closed")
+                    self._reap_dead()
+                    waited_since = self._check_stall(ordinal, waited_since)
+                    self._cv.wait(self._POLL_S)
+                value, is_error = self._results.pop(ordinal)
+                self._next += 1
+                _telemetry.gauge("io.plane.queue_depth").set(
+                    len(self._results))
+                self._cv.notify_all()
+                self._wake_workers()  # a backpressure slot just opened
+        if is_error:
+            raise value
+        _telemetry.counter("io.plane.batches").inc()
+        return value
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            self._wake_workers()
+
+    def _wake_workers(self):
+        """(under lock) Unpark every idle worker thread (they wait on
+        worker-owned events, not the pool condition — see
+        ``_worker_loop``)."""
+        for worker in self._workers:
+            worker.wakeup.set()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------- supervision
+
+    def _reap_dead(self):
+        """(under lock) Respawn dead workers, reassigning their shard."""
+        for i, worker in enumerate(self._workers):
+            if not worker.dead:
+                continue
+            leftovers = deque(worker.queue)
+            if worker.crashed is not None:
+                ordinal, exc = worker.crashed
+                if self._fail_or_retry(ordinal, exc):
+                    leftovers.appendleft(ordinal)
+            replacement = self._spawn(worker.wid)
+            replacement.queue = leftovers
+            self._workers[i] = replacement
+            _telemetry.counter("io.plane.worker_restart").inc()
+            self._cv.notify_all()
+
+    def _check_stall(self, ordinal, waited_since):
+        """(under lock) Watchdog: if the worker owning ``ordinal`` has
+        been decoding it longer than the timeout, abandon that worker
+        and deal its shard (stuck ordinal first) to a fresh slot."""
+        if self._timeout_ms <= 0:
+            return waited_since
+        now = time.monotonic()
+        if (now - waited_since) * 1000.0 < self._timeout_ms:
+            return waited_since
+        for i, worker in enumerate(self._workers):
+            if worker.current != ordinal or worker.dead or worker.abandoned:
+                continue
+            if (now - worker.started_at) * 1000.0 < self._timeout_ms:
+                continue
+            worker.abandoned = True
+            _telemetry.counter("io.plane.worker_stall").inc()
+            leftovers = deque(worker.queue)
+            worker.queue = deque()
+            if self._fail_or_retry(ordinal, MXNetError(
+                    f"io.plane: decode of batch {ordinal} stalled past "
+                    f"{self._timeout_ms:.0f}ms")):
+                leftovers.appendleft(ordinal)
+            replacement = self._spawn(worker.wid)
+            replacement.queue = leftovers
+            self._workers[i] = replacement
+            _telemetry.counter("io.plane.worker_restart").inc()
+            self._cv.notify_all()
+            break
+        return time.monotonic()
+
+    def _fail_or_retry(self, ordinal, exc):
+        """(under lock) True when ``ordinal`` deserves another attempt;
+        otherwise stores ``exc`` as its in-order result."""
+        if self._attempts.get(ordinal, 0) < 3:
+            return True
+        if ordinal >= self._next and ordinal not in self._results:
+            self._results[ordinal] = (exc, True)
+        return False
+
+    # ------------------------------------------------------------ worker
+
+    def _spawn(self, wid):
+        worker = _Worker(wid)
+        worker.thread = threading.Thread(
+            target=_worker_loop, args=(weakref.ref(self), worker),
+            name=f"mx-io-decode-{wid}", daemon=True)
+        worker.thread.start()
+        return worker
+
+    def _claim_step(self, worker):
+        """One bounded attempt to claim this worker's next ordinal
+        (reorder buffer has room, honouring backpressure). Returns a
+        ``(generation, ordinal, payload)`` claim, ``"exit"`` when the
+        worker should stop, or None after waiting one poll slice —
+        the caller loops, re-taking its pool reference each slice so a
+        dropped pool is collectable (see ``_worker_loop``)."""
+        with self._cv:
+            if self._closed or worker.abandoned:
+                return "exit"
+            while worker.queue:
+                ordinal = worker.queue[0]
+                if ordinal < self._next:             # already satisfied
+                    worker.queue.popleft()
+                    continue
+                if ordinal < self._next + self._depth:
+                    worker.queue.popleft()
+                    worker.current = ordinal
+                    worker.started_at = time.monotonic()
+                    self._attempts[ordinal] = (
+                        self._attempts.get(ordinal, 0) + 1)
+                    if worker.blocked_since is not None:
+                        _telemetry.histogram(
+                            "io.plane.backpressure_us").observe(
+                            (time.monotonic() - worker.blocked_since) * 1e6)
+                        worker.blocked_since = None
+                    return (self._generation, ordinal,
+                            self._tasks.get(ordinal))
+                if worker.blocked_since is None:     # buffer full
+                    worker.blocked_since = time.monotonic()
+                break
+            return None
+
+    def _store(self, worker, generation, ordinal, value, is_error=False):
+        with self._cv:
+            worker.current = None
+            if generation != self._generation or worker.abandoned:
+                return                    # stale epoch or watchdog lost faith
+            if ordinal >= self._next and ordinal not in self._results:
+                self._results[ordinal] = (value, is_error)
+                _telemetry.gauge("io.plane.queue_depth").set(
+                    len(self._results))
+            self._cv.notify_all()
+
+
+_UNSET = object()
+
+
+# graftlint: hotpath
+def _worker_loop(pool_ref, worker):
+    """Decode-worker thread body. Deliberately a module function holding
+    only a WEAK reference to its pool between claim slices: a bound
+    method on the thread's stack would root the pool (and through
+    ``_decode``, the owning iterator) forever, so an un-``close()``d
+    iterator would leak its worker threads for the life of the process.
+    With the weakref, dropping the last iterator reference collects the
+    pool and every worker exits within one poll slice."""
+    state = _UNSET
+    while True:
+        pool = pool_ref()
+        if pool is None:
+            return
+        if state is _UNSET:
+            state = (pool._state_factory() if pool._state_factory
+                     else None)
+        claim = pool._claim_step(worker)
+        if claim == "exit":
+            return
+        if claim is None:
+            # idle: park on the worker-owned event with NO pool
+            # reference on this stack (the poll timeout is only the
+            # safety net for a pool that died un-closed)
+            del pool
+            worker.wakeup.wait(_POLL_S)
+            worker.wakeup.clear()
+            continue
+        generation, ordinal, payload = claim
+        try:
+            from . import faultinject as _faultinject
+            _faultinject.on_io_decode(ordinal)
+            with _telemetry.span("io.plane.decode"):
+                value = pool._decode(payload, state)
+        except MXNetError as exc:
+            # data error: delivered in order, worker stays alive
+            pool._store(worker, generation, ordinal, exc, is_error=True)
+        except BaseException as exc:      # worker death, incl. injected
+            with pool._cv:
+                worker.current = None
+                worker.dead = True
+                if generation == pool._generation:
+                    worker.crashed = (ordinal, exc)
+                pool._cv.notify_all()
+            _telemetry.counter("io.plane.worker_crash").inc()
+            return
+        else:
+            pool._store(worker, generation, ordinal, value)
+        del pool

@@ -8,9 +8,15 @@ chunked InputSplit read → OpenMP JPEG decode + augment → pinned batch).
 The binary format here is byte-compatible with dmlc RecordIO (magic
 ``0xced7230a`` framing with 4-byte alignment and the IRHeader struct), so
 ``.rec`` files packed by the reference's ``im2rec`` tools load unchanged.
-The decode pipeline uses a thread pool (OpenCV releases the GIL) feeding
-double-buffered batches — the python analogue of the reference's OpenMP
-ParseChunk; a C++ data plane can replace it behind the same iterator API.
+
+The decode pipeline fans out over ``preprocess_threads`` supervised
+workers (:class:`mxnet_tpu.io_plane.DecodePool`) — the python analogue
+of the reference's chunked InputSplit read → OpenMP ParseChunk →
+prefetched-batch pipeline. The coordinator (``reset()``) fixes the
+epoch's batch order and RNG seeds before any worker runs, so the pooled
+stream is byte-identical to the serial path at a fixed seed; see
+``docs/io.md``. ``MXNET_IO_POOL=0`` (or ``use_pool=False``) falls back
+to the single-consumer serial path.
 """
 
 from __future__ import annotations
@@ -24,7 +30,9 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from . import telemetry as _telemetry
 from .base import MXNetError
+from .io_plane import DecodePool, input_split
 
 _MAGIC = 0xCED7230A
 _KMAGIC_PACK = struct.Struct("<I")
@@ -249,10 +257,16 @@ class ImageRecordIter:
                  part_index=0, num_parts=1, preprocess_threads=None,
                  round_batch=True, seed=0, data_name="data",
                  label_name="softmax_label", path_imgidx=None,
-                 use_native=None, **kwargs):
+                 use_native=None, use_pool=None, dtype="float32", **kwargs):
+        from .base import np_dtype
+
         self.path_imgrec = path_imgrec
         self.data_shape = tuple(data_shape)
         self.batch_size = batch_size
+        # emit dtype (reference ImageRecordIter dtype param): decode and
+        # augment stay f32; the batch is cast once at assembly so a
+        # bfloat16-bound executor sees its compiled input signature
+        self.dtype = np_dtype(dtype)
         self.label_width = label_width
         self.shuffle = shuffle
         self.rand_crop = rand_crop
@@ -328,14 +342,18 @@ class ImageRecordIter:
                     "use_native=True requires 3-channel RGB data_shape"
                 )
         self._native = bool(use_native)
+        # distributed sharding (reference InputSplit part_index/num_parts)
+        # shares one helper with the pool's per-worker batch split
         if self._native:
-            self._offsets = _native.scan(path_imgrec)[part_index::num_parts]
+            self._offsets = input_split(
+                _native.scan(path_imgrec), part_index, num_parts)
             self._rec = None
             self._pool = None
         else:
             import cv2  # noqa: F401 — fail early if decode backend missing
 
-            self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+            # serial-path executor, created lazily on first _fetch
+            self._pool = None
             # index all record offsets once (sequential scan)
             self._offsets = []
             rec = MXRecordIO(path_imgrec, "r")
@@ -346,17 +364,28 @@ class ImageRecordIter:
                     break
                 self._offsets.append(pos)
             rec.close()
-            # shard for distributed workers (reference InputSplit part_index)
-            self._offsets = self._offsets[part_index::num_parts]
+            self._offsets = input_split(self._offsets, part_index, num_parts)
             self._rec = MXRecordIO(path_imgrec, "r")
         self._order = np.arange(len(self._offsets))
+        if use_pool is None:
+            use_pool = bool(_env.get("MXNET_IO_POOL"))
+        self._dpool = None
+        if use_pool:
+            # each python-plane worker owns its own reader so decode never
+            # serialises on the shared file handle; the native plane reopens
+            # per call and needs no state
+            worker_state = (None if self._native else
+                            lambda: MXRecordIO(self.path_imgrec, "r"))
+            self._dpool = DecodePool(self._decode_batch, self._threads,
+                                     worker_state=worker_state)
         self.reset()
 
     @property
     def provide_data(self):
         from .io import DataDesc
 
-        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape)]
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape,
+                         self.dtype)]
 
     @property
     def provide_label(self):
@@ -370,23 +399,53 @@ class ImageRecordIter:
         if self.shuffle:
             self.rs.shuffle(self._order)
         self._cursor = 0
+        if self._dpool is not None:
+            self._start_pooled_epoch()
+
+    def _start_pooled_epoch(self):
+        """Hand the epoch to the decode pool: batch order and per-batch
+        seeds are fixed here, in batch order, consuming ``self.rs``
+        exactly as the serial path's lazy per-batch draws would — that
+        (plus the ordered reorder buffer) is the byte-parity contract."""
+        size = self.batch_size
+        payloads = []
+        for start in range(0, len(self._order) - size + 1, size):
+            idxs = np.array(self._order[start:start + size])
+            if self._native:
+                payloads.append((idxs, int(self.rs.randint(0, 2 ** 31 - 1))))
+            else:
+                payloads.append(
+                    (idxs, self.rs.randint(0, 2 ** 31 - 1, size=size)))
+        self._dpool.start_epoch(payloads)
+
+    def close(self):
+        """Stop the decode-pool workers (idempotent)."""
+        if getattr(self, "_dpool", None) is not None:
+            self._dpool.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __iter__(self):
         return self
 
-    def _load_one(self, offset, seed):
+    def _load_one(self, offset, seed, rec=None):
         import cv2
 
         # per-record RandomState: pool workers run concurrently; a shared
         # RandomState is thread-unsafe and schedule-dependent, so per-item
         # seeds drawn sequentially keep augmentation reproducible
         rs = np.random.RandomState(seed)
-        self._lock.acquire()
-        try:
-            self._rec.handle.seek(offset)
-            buf = self._rec.read()
-        finally:
-            self._lock.release()
+        if rec is not None:  # pool worker's private reader: lock-free
+            rec.seek(offset)
+            buf = rec.read()
+        else:
+            with self._lock:
+                self._rec.handle.seek(offset)
+                buf = self._rec.read()
         header, img_buf = unpack(buf)
         img = cv2.imdecode(np.frombuffer(img_buf, np.uint8), cv2.IMREAD_COLOR)
         img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
@@ -460,17 +519,57 @@ class ImageRecordIter:
 
     _lock = threading.Lock()
 
-    def _fetch(self):
+    def _assemble(self, results):
+        """Stack per-record (arr, label) pairs into batch arrays."""
+        data = np.stack([r[0] for r in results])
+        if self.label_width == 1:
+            label = np.array([np.ravel(r[1])[0] for r in results],
+                             dtype=np.float32)
+        else:
+            label = np.stack(
+                [np.ravel(r[1])[: self.label_width] for r in results]
+            ).astype(np.float32)
+        return data, label
+
+    def _batch_from_arrays(self, data, label):
         from .io import DataBatch
         from .ndarray import array
 
+        if data.dtype != self.dtype:
+            data = data.astype(self.dtype)
+        return DataBatch(
+            data=[array(data)], label=[array(label)], pad=0, index=None,
+            provide_data=self.provide_data, provide_label=self.provide_label,
+        )
+
+    def _decode_batch(self, payload, rec):
+        """DecodePool decode fn — a pure function of ``payload``
+        (batch indices + coordinator-drawn seed(s)) and the worker's
+        private reader ``rec`` (python plane only)."""
+        idxs, seeds = payload
+        if self._native:
+            data, label = self._load_native_arrays(idxs, seeds,
+                                                   num_threads=1)
+        else:
+            results = [self._load_one(self._offsets[i], s, rec=rec)
+                       for i, s in zip(idxs, seeds)]
+            data, label = self._assemble(results)
+        _telemetry.counter("io.plane.records").inc(len(idxs))
+        return data, label
+
+    # graftlint: hotpath
+    def _fetch(self):
         n = len(self._order)
         if self._cursor + self.batch_size > n:
             raise StopIteration
+        if self._dpool is not None:
+            return self._fetch_pooled()
         idxs = self._order[self._cursor:self._cursor + self.batch_size]
         self._cursor += self.batch_size
         if self._native:
             return self._fetch_native(idxs)
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=self._threads)
         seeds = self.rs.randint(0, 2 ** 31 - 1, size=len(idxs))
         results = list(
             self._pool.map(
@@ -478,22 +577,23 @@ class ImageRecordIter:
                 zip(idxs, seeds),
             )
         )
-        data = np.stack([r[0] for r in results])
-        if self.label_width == 1:
-            label = np.array([np.ravel(r[1])[0] for r in results], dtype=np.float32)
-        else:
-            label = np.stack([np.ravel(r[1])[: self.label_width] for r in results]).astype(np.float32)
-        return DataBatch(
-            data=[array(data)], label=[array(label)], pad=0, index=None,
-            provide_data=self.provide_data, provide_label=self.provide_label,
-        )
+        return self._batch_from_arrays(*self._assemble(results))
+
+    def _fetch_pooled(self):
+        # cursor advances before next_result so a stored decode error
+        # (re-raised here, like the serial path) doesn't desync the
+        # iterator from the pool's consumed-ordinal sequence
+        self._cursor += self.batch_size
+        data, label = self._dpool.next_result()
+        return self._batch_from_arrays(data, label)
 
     _cur = None
 
-    def _fetch_native(self, idxs):
+    def _load_native_arrays(self, idxs, seed, num_threads):
+        """One native-plane batch as (data, label) numpy arrays. Output
+        is independent of ``num_threads`` (per-record seed derivation),
+        so pool workers run it single-threaded without changing bytes."""
         from . import native as _native
-        from .io import DataBatch
-        from .ndarray import array
 
         extra = {k: v for k, v in self.aug.items() if k != "inter_method"}
         data, labels, ok = _native.load_batch(
@@ -505,8 +605,8 @@ class ImageRecordIter:
             rand_mirror=self.rand_mirror,
             mean=self.mean, std=self.std, scale=self.scale,
             label_width=self.label_width,
-            seed=int(self.rs.randint(0, 2 ** 31 - 1)),
-            num_threads=self._threads,
+            seed=int(seed),
+            num_threads=num_threads,
             **extra,
         )
         if ok < len(idxs):
@@ -519,10 +619,12 @@ class ImageRecordIter:
                 "(smaller than max_crop_size)"
             )
         label = labels[:, 0] if self.label_width == 1 else labels
-        return DataBatch(
-            data=[array(data)], label=[array(label)], pad=0, index=None,
-            provide_data=self.provide_data, provide_label=self.provide_label,
-        )
+        return data, label
+
+    def _fetch_native(self, idxs):
+        data, label = self._load_native_arrays(
+            idxs, self.rs.randint(0, 2 ** 31 - 1), self._threads)
+        return self._batch_from_arrays(data, label)
 
     # --- DataIter protocol (iter_next advances; getdata reads current) ----
     def next(self):

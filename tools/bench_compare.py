@@ -17,7 +17,8 @@ discovered by walking both records and matching leaf names:
 
 * higher-is-better: ``value``, ``*_per_sec``, ``mfu*``, ``vs_baseline``,
   ``fused_speedup``, ``availability``, ``replica_scaling``,
-  ``group_scaling_4x`` — regression = new < base.
+  ``group_scaling_4x``, ``pool_speedup`` (the BENCH_MODE=io decode-pool
+  vs serial ratio) — regression = new < base.
 * lower-is-better: ``steady_compiles`` (the zero-recompile invariant:
   ANY increase past the threshold fails), plus any path named via
   ``--lower-better``.
@@ -37,7 +38,7 @@ import json
 import sys
 
 _HIGHER_LEAVES = ("value", "vs_baseline", "fused_speedup", "availability",
-                  "replica_scaling", "group_scaling_4x")
+                  "replica_scaling", "group_scaling_4x", "pool_speedup")
 _HIGHER_PREFIXES = ("mfu",)
 _HIGHER_SUFFIXES = ("_per_sec",)
 _LOWER_LEAVES = ("steady_compiles",)
