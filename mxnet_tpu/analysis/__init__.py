@@ -7,16 +7,27 @@ the env/telemetry catalogues drift silently. This package checks them at
 the call site they are introduced, across every path, without running a
 chip:
 
-- ``host-sync``      — blocking device→host syncs inside declared hot paths
+- ``host-sync``      — blocking device→host syncs anywhere *reachable*
+                       from the declared hot roots (whole-program
+                       reachability over :mod:`analysis.callgraph`)
 - ``trace-purity``   — impure host effects inside code captured by
                        ``jax.jit`` / ``lax.fori_loop`` / ``lax.scan``
 - ``env-registry``   — every ``MXNET_*`` environ read routes through
                        :mod:`mxnet_tpu.env`; registry and docs stay in sync
 - ``telemetry-catalog`` — instrument names are literal, follow the
                        ``sub.system.name`` convention and are documented
-- ``lock-discipline`` — lock-order cycles, mixed guarded/unguarded field
-                       mutation, blocking work under the batcher run lock
+- ``lock-discipline`` — interprocedural lock-set analysis tree-wide:
+                       ABBA cycles across classes, re-acquisition through
+                       call chains, mixed guarded/unguarded mutation,
+                       blocking work under a held lock
+- ``exception-swallow`` — catch-alls that silently drop errors inside
+                       worker/supervision loops
 - ``typos``          — transcription tells (known-typo identifier list)
+
+Two engines back the suite: :mod:`analysis.callgraph` (the whole-program
+call graph the interprocedural checkers share, built once per run) and
+:mod:`analysis.sanitizer` (the *runtime* half — instrumented locks that
+watch the same orderings during tier-1's concurrency suites).
 
 Suppression: ``# graftlint: allow=<check>(<reason>)`` — file-wide on a
 comment-only line, single-line as a trailing comment. Grandfathered
@@ -29,6 +40,6 @@ linting must not require a working jax install.
 """
 
 from .core import (  # noqa: F401
-    Finding, LintResult, SourceUnit, all_checkers, checker_names,
-    load_baseline, run_suite, write_baseline,
+    Finding, LintResult, SourceUnit, all_checkers, build_context,
+    checker_names, load_baseline, run_suite, write_baseline,
 )

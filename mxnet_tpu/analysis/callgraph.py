@@ -1,0 +1,515 @@
+"""Whole-program call graph over the lint tree (stdlib ``ast`` only).
+
+The PR-8 checkers were intraprocedural: ``host-sync`` looked only inside
+functions a table declared hot, ``lock-discipline`` resolved calls within
+one class. Both invariants are really *reachability* properties — a sync
+two frames below ``fit`` stalls the pipeline exactly as hard as one in
+``fit`` itself, and an ABBA pair split across two classes deadlocks just
+like one split across two methods. This module gives every checker the
+same project-wide call graph so they can reason transitively.
+
+Resolution rules (deliberately conservative — a wrong edge is worse than
+a missing one, and every *missing* one is accounted for):
+
+- ``name(...)``             — an enclosing/nested ``def`` in the same
+  module (innermost visible wins), a module-level ``def``/``class``, or a
+  ``from .mod import name`` import. A class resolves to its
+  ``__init__`` when it defines one.
+- ``self.m(...)``/``cls.m(...)`` — the enclosing class's method, walking
+  in-tree base classes (single inheritance chains resolved through
+  imports).
+- ``mod.f(...)``            — ``mod`` bound by ``import``/``from x
+  import mod``; resolved against that module's top-level defs when the
+  module is in the tree, classified *external* when it is not
+  (``np.dot`` is not an unresolved call, it is somebody else's code).
+- ``obj.m(...)``            — the *unique-attribute-owner* heuristic:
+  when exactly one in-tree class defines a method ``m`` (and ``m`` is
+  not a stdlib container/primitive method name), the call resolves to
+  it. Zero or several owners → an **unresolved** call, recorded with its
+  reason; ``--callgraph`` prints them so the blind spots are visible
+  instead of silently absent.
+
+Nested ``def``s get a ``defines`` edge from their enclosing function —
+followed by reachability analyses (a closure built on a hot path runs on
+the hot path) but ignored by lock-set propagation (defining a function
+acquires nothing).
+
+The module is self-contained and framework-free: it must be importable
+with jax absent or sabotaged (tools/lint.py loads the analysis package
+standalone).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from collections import deque
+
+from .core import dotted, iter_defs
+
+__all__ = ["CallGraph", "CallSite", "FuncNode", "module_name"]
+
+#: bare names that are builtins — calling one is neither an edge nor an
+#: unresolved call.
+_BUILTINS = frozenset(dir(builtins))
+
+#: method names owned by stdlib containers/primitives: never resolved by
+#: the unique-attribute-owner heuristic, even if one tree class happens
+#: to define the same name (``d.get(...)`` on a dict must not resolve to
+#: ``SomeCache.get``).
+_STDLIB_METHODS = frozenset(
+    n for t in (dict, list, set, frozenset, tuple, str, bytes, bytearray,
+                deque)
+    for n in dir(t) if not n.startswith("__")
+) | frozenset({
+    # threading / queue / concurrent primitives (never in-tree targets)
+    "acquire", "release", "locked", "notify", "notify_all", "wait",
+    "wait_for", "set", "is_set", "put", "get", "put_nowait", "get_nowait",
+    "task_done", "join", "start", "is_alive", "cancel", "result",
+    "set_result", "set_exception", "add_done_callback", "submit_to",
+    # file / io
+    "read", "write", "readline", "readlines", "seek", "tell", "flush",
+    "fileno",
+})
+
+
+def module_name(path):
+    """Dotted module name of a repo-relative path: ``mxnet_tpu/serving/
+    batcher.py`` → ``mxnet_tpu.serving.batcher``; packages drop their
+    ``__init__``."""
+    p = path[:-3] if path.endswith(".py") else path
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+class FuncNode:
+    """One function/method in the graph."""
+
+    __slots__ = ("node_id", "path", "qual", "cls", "fn", "module")
+
+    def __init__(self, path, qual, cls, fn, module):
+        self.node_id = f"{path}::{qual}"
+        self.path = path
+        self.qual = qual            # dotted within the module
+        self.cls = cls              # immediate enclosing class name or None
+        self.fn = fn                # the ast.FunctionDef
+        self.module = module        # dotted module name
+
+    @property
+    def dotted(self):
+        return f"{self.module}.{self.qual}"
+
+    def __repr__(self):
+        return f"<FuncNode {self.node_id}>"
+
+
+class CallSite:
+    """One resolved edge occurrence: caller line + callee node id."""
+
+    __slots__ = ("callee", "line", "kind")
+
+    def __init__(self, callee, line, kind="call"):
+        self.callee = callee
+        self.line = line
+        self.kind = kind            # "call" | "defines"
+
+
+class _ModuleInfo:
+    """Per-unit resolution state."""
+
+    __slots__ = ("unit", "module", "nodes", "top_funcs", "class_methods",
+                 "class_bases", "mod_aliases", "from_names", "classes")
+
+    def __init__(self, unit):
+        self.unit = unit
+        self.module = module_name(unit.path)
+        self.nodes = {}          # qual -> FuncNode
+        self.top_funcs = {}      # top-level def name -> qual
+        self.class_methods = {}  # class simple name -> {method -> qual}
+        self.class_bases = {}    # class simple name -> [base name strings]
+        self.classes = set()
+        self.mod_aliases = {}    # local name -> dotted module
+        self.from_names = {}     # local name -> (dotted module, symbol)
+
+
+class CallGraph:
+    """Project-wide call graph. Build once per :class:`TreeContext` via
+    ``ctx.callgraph()``; checkers share the instance."""
+
+    def __init__(self):
+        self.nodes = {}          # node_id -> FuncNode
+        self.edges = {}          # node_id -> [CallSite] (sorted by line)
+        self.rev = {}            # node_id -> [(caller_id, line)]
+        self.unresolved = {}     # node_id -> [(line, text, reason)]
+        self._mods = {}          # dotted module -> _ModuleInfo
+        self._attr_owners = {}   # method name -> [(module, class, qual)]
+
+    # ------------------------------------------------------------ build
+
+    @classmethod
+    def build(cls, ctx):
+        g = cls()
+        for unit in ctx.units:
+            if unit.tree is None:
+                continue
+            g._index_unit(unit)
+        g._collect_attr_owners()
+        for mi in g._sorted_mods():
+            g._resolve_module(mi)
+        return g
+
+    def _sorted_mods(self):
+        return [self._mods[m] for m in sorted(self._mods)]
+
+    def _index_unit(self, unit):
+        mi = _ModuleInfo(unit)
+        self._mods[mi.module] = mi
+        for qual, cls_name, fn in iter_defs(unit.tree):
+            node = FuncNode(unit.path, qual, cls_name, fn, mi.module)
+            mi.nodes[qual] = node
+            self.nodes[node.node_id] = node
+            if "." not in qual:
+                mi.top_funcs[qual] = qual
+            if cls_name is not None and qual.startswith(cls_name + "."):
+                tail = qual[len(cls_name) + 1:]
+                if "." not in tail:   # a direct method, not a nested def
+                    mi.class_methods.setdefault(cls_name, {})[tail] = qual
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.ClassDef):
+                mi.classes.add(node.name)
+                mi.class_methods.setdefault(node.name, {})
+                mi.class_bases[node.name] = [
+                    b for b in (dotted(base) for base in node.bases)
+                    if b is not None]
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname is not None:
+                        mi.mod_aliases[a.asname] = a.name
+                    else:
+                        # `import a.b.c` binds `a`; deeper components
+                        # come back in the call's attribute chain
+                        head = a.name.split(".")[0]
+                        mi.mod_aliases[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                base = self._import_base(mi, node)
+                if base is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    mi.from_names[local] = (base, a.name)
+
+    @staticmethod
+    def _import_base(mi, node):
+        """Dotted module an ``ImportFrom`` resolves against."""
+        if node.level == 0:
+            return node.module or ""
+        # relative: strip `level` components off this module's package
+        parts = mi.module.split(".")
+        # the module itself is parts[:-1]'s member (non-package files)
+        pkg = parts[:-1]
+        up = node.level - 1
+        if up > len(pkg):
+            return None
+        base = pkg[: len(pkg) - up]
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base)
+
+    def _collect_attr_owners(self):
+        for mi in self._sorted_mods():
+            for cls_name in sorted(mi.class_methods):
+                for meth, qual in sorted(mi.class_methods[cls_name].items()):
+                    self._attr_owners.setdefault(meth, []).append(
+                        (mi, cls_name, qual))
+
+    # ---------------------------------------------------- per-module pass
+
+    def _resolve_module(self, mi):
+        for qual in sorted(mi.nodes):
+            node = mi.nodes[qual]
+            self.edges.setdefault(node.node_id, [])
+            for item in iter_own_scope(node.fn):
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nested = mi.nodes.get(f"{qual}.{item.name}")
+                    if nested is not None:
+                        self._add_edge(node, nested, item.lineno, "defines")
+                    continue
+                if isinstance(item, ast.Call):
+                    self._resolve_call(mi, node, item)
+
+    def _add_edge(self, caller, callee, line, kind="call"):
+        self.edges.setdefault(caller.node_id, []).append(
+            CallSite(callee.node_id, line, kind))
+        self.rev.setdefault(callee.node_id, []).append(
+            (caller.node_id, line))
+
+    def _note_unresolved(self, caller, call, reason):
+        text = dotted(call.func)
+        if text is None:
+            text = getattr(call.func, "attr", None)
+            text = f"?.{text}(...)" if text else "<dynamic>(...)"
+        else:
+            text += "(...)"
+        self.unresolved.setdefault(caller.node_id, []).append(
+            (call.lineno, text, reason))
+
+    def _resolve_call(self, mi, caller, call):
+        func = call.func
+        if isinstance(func, ast.Name):
+            self._resolve_name_call(mi, caller, call, func.id)
+            return
+        if not isinstance(func, ast.Attribute):
+            return  # calling a call/subscript result: out of model
+        chain = dotted(func)
+        attr = func.attr
+        if chain is not None:
+            root = chain.split(".")[0]
+            parts = chain.split(".")
+            if root in ("self", "cls") and caller.cls is not None \
+                    and len(parts) == 2:
+                target = self._resolve_method(mi, caller.cls, attr)
+                if target is not None:
+                    self._add_edge(caller, target, call.lineno)
+                    return
+                # fall through to the unique-owner heuristic (the method
+                # may live on a mixin/base outside this module chain)
+            elif root in mi.mod_aliases:
+                # module attribute call: `tm.counter(...)` or, with
+                # `import a.b`, the full dotted `a.b.f(...)` chain
+                target_mod = ".".join([mi.mod_aliases[root]] + parts[1:-1])
+                name = parts[-1]
+                tmi = self._mods.get(target_mod)
+                if tmi is None:
+                    return  # external module (np/jax/os/...): not ours
+                if name in tmi.top_funcs:
+                    self._add_edge(caller, tmi.nodes[tmi.top_funcs[name]],
+                                   call.lineno)
+                    return
+                if name in tmi.classes:
+                    ctor = tmi.class_methods[name].get("__init__")
+                    if ctor is not None:
+                        self._add_edge(caller, tmi.nodes[ctor],
+                                       call.lineno)
+                    return
+                self._note_unresolved(
+                    caller, call,
+                    f"no such def in in-tree module {target_mod}")
+                return
+            elif root in mi.from_names:
+                src_mod, sym = mi.from_names[root]
+                submod = f"{src_mod}.{sym}" if src_mod else sym
+                if submod in self._mods and len(parts) == 2:
+                    # `from . import errors` binds the submodule itself
+                    tmi = self._mods[submod]
+                    if attr in tmi.top_funcs:
+                        self._add_edge(
+                            caller, tmi.nodes[tmi.top_funcs[attr]],
+                            call.lineno)
+                        return
+                    if attr in tmi.classes:
+                        ctor = tmi.class_methods[attr].get("__init__")
+                        if ctor is not None:
+                            self._add_edge(caller, tmi.nodes[ctor],
+                                           call.lineno)
+                        return
+                    self._note_unresolved(
+                        caller, call,
+                        f"no such def in in-tree module {submod}")
+                    return
+                tmi = self._mods.get(src_mod)
+                if tmi is not None and sym in tmi.classes \
+                        and len(parts) == 2:
+                    target = self._resolve_method_in(tmi, sym, attr)
+                    if target is not None:
+                        self._add_edge(caller, target, call.lineno)
+                        return
+        # foreign receiver: unique-attribute-owner
+        self._resolve_by_owner(mi, caller, call, attr)
+
+    def _resolve_name_call(self, mi, caller, call, name):
+        # innermost visible nested def, walking the enclosing chain
+        prefix = caller.qual
+        while prefix:
+            cand = mi.nodes.get(f"{prefix}.{name}")
+            if cand is not None:
+                self._add_edge(caller, cand, call.lineno)
+                return
+            prefix = prefix.rsplit(".", 1)[0] if "." in prefix else ""
+        if name in mi.top_funcs:
+            self._add_edge(caller, mi.nodes[mi.top_funcs[name]],
+                           call.lineno)
+            return
+        if name in mi.classes:
+            ctor = mi.class_methods[name].get("__init__")
+            if ctor is not None:
+                self._add_edge(caller, mi.nodes[ctor], call.lineno)
+            return
+        if name in mi.from_names:
+            src_mod, sym = mi.from_names[name]
+            tmi = self._mods.get(src_mod)
+            if tmi is None:
+                return  # imported from an external module
+            if sym in tmi.top_funcs:
+                self._add_edge(caller, tmi.nodes[tmi.top_funcs[sym]],
+                               call.lineno)
+                return
+            if sym in tmi.classes:
+                ctor = tmi.class_methods[sym].get("__init__")
+                if ctor is not None:
+                    self._add_edge(caller, tmi.nodes[ctor], call.lineno)
+                return
+            self._note_unresolved(
+                caller, call, f"{sym} not found in in-tree {src_mod}")
+            return
+        if name in mi.mod_aliases or name in _BUILTINS:
+            return
+        self._note_unresolved(caller, call, "unknown bare name")
+
+    def _resolve_by_owner(self, mi, caller, call, attr):
+        if attr in _STDLIB_METHODS:
+            return  # container/primitive API: never an in-tree target
+        owners = self._attr_owners.get(attr, [])
+        if len(owners) == 1:
+            omi, _cls, qual = owners[0]
+            self._add_edge(caller, omi.nodes[qual], call.lineno)
+        elif not owners:
+            self._note_unresolved(caller, call,
+                                  "receiver unknown, no in-tree owner")
+        else:
+            names = sorted({f"{o[0].module}.{o[1]}" for o in owners})
+            self._note_unresolved(
+                caller, call,
+                f"ambiguous receiver ({len(owners)} owners: "
+                + ", ".join(names[:4])
+                + ("…" if len(names) > 4 else "") + ")")
+
+    def _resolve_method(self, mi, cls_name, meth, _seen=None):
+        """Method lookup through in-tree single-inheritance chains."""
+        return self._resolve_method_in(mi, cls_name, meth, _seen)
+
+    def _resolve_method_in(self, mi, cls_name, meth, _seen=None):
+        _seen = _seen or set()
+        key = (mi.module, cls_name)
+        if key in _seen:
+            return None
+        _seen.add(key)
+        methods = mi.class_methods.get(cls_name)
+        if methods and meth in methods:
+            return mi.nodes[methods[meth]]
+        for base in mi.class_bases.get(cls_name, ()):
+            base_simple = base.split(".")[-1]
+            if base in mi.classes or base_simple in mi.classes:
+                found = self._resolve_method_in(
+                    mi, base if base in mi.classes else base_simple,
+                    meth, _seen)
+            elif base in mi.from_names:
+                src_mod, sym = mi.from_names[base]
+                tmi = self._mods.get(src_mod)
+                found = (self._resolve_method_in(tmi, sym, meth, _seen)
+                         if tmi is not None else None)
+            elif "." in base and base.split(".")[0] in mi.mod_aliases:
+                tmod = mi.mod_aliases[base.split(".")[0]]
+                tmi = self._mods.get(tmod)
+                found = (self._resolve_method_in(tmi, base_simple, meth,
+                                                 _seen)
+                         if tmi is not None else None)
+            else:
+                found = None
+            if found is not None:
+                return found
+        return None
+
+    # ---------------------------------------------------------- queries
+
+    def callees(self, node_id):
+        return sorted(self.edges.get(node_id, ()),
+                      key=lambda s: (s.line, s.callee))
+
+    def callers(self, node_id):
+        return sorted(self.rev.get(node_id, ()))
+
+    def find(self, qualname):
+        """Node ids whose dotted name equals or suffix-matches
+        ``qualname`` (``DecodePool.next_result`` matches
+        ``mxnet_tpu.io_plane.DecodePool.next_result``)."""
+        hits = []
+        for node_id in sorted(self.nodes):
+            d = self.nodes[node_id].dotted
+            if d == qualname or d.endswith("." + qualname):
+                hits.append(node_id)
+        return hits
+
+    def node_for(self, path, qual):
+        return self.nodes.get(f"{path}::{qual}")
+
+    def reachable(self, roots, edge_filter=None):
+        """BFS from ``roots`` (node ids). Returns ``{node_id: chain}``
+        where ``chain`` is the shortest root→node path as a list of node
+        ids (roots map to ``[root]``). Deterministic: ties broken by
+        sorted traversal order. ``edge_filter(caller_node, site) ->
+        bool`` can prune edges (False = do not follow)."""
+        chains = {}
+        frontier = deque()
+        for r in sorted(set(roots)):
+            if r in self.nodes and r not in chains:
+                chains[r] = [r]
+                frontier.append(r)
+        while frontier:
+            cur = frontier.popleft()
+            cur_node = self.nodes[cur]
+            for site in self.callees(cur):
+                if site.callee in chains:
+                    continue
+                if edge_filter is not None \
+                        and not edge_filter(cur_node, site):
+                    continue
+                chains[site.callee] = chains[cur] + [site.callee]
+                frontier.append(site.callee)
+        return chains
+
+    def describe(self, node_id):
+        """Human-readable callees/callers/unresolved block for the CLI's
+        ``--callgraph`` debug mode."""
+        node = self.nodes[node_id]
+        lines = [f"{node.dotted}  ({node.path}:{node.fn.lineno})"]
+        sites = self.callees(node_id)
+        lines.append(f"  callees ({len(sites)}):")
+        for s in sites:
+            tag = " [defines]" if s.kind == "defines" else ""
+            lines.append(
+                f"    {self.nodes[s.callee].dotted}  "
+                f"(line {s.line}){tag}")
+        callers = self.callers(node_id)
+        lines.append(f"  callers ({len(callers)}):")
+        for caller_id, line in callers:
+            lines.append(
+                f"    {self.nodes[caller_id].dotted}  (line {line})")
+        unres = sorted(self.unresolved.get(node_id, ()))
+        lines.append(f"  unresolved calls ({len(unres)}):")
+        for line, text, reason in unres:
+            lines.append(f"    line {line}: {text} — {reason}")
+        return "\n".join(lines)
+
+    def stats(self):
+        resolved = sum(len(v) for v in self.edges.values())
+        unresolved = sum(len(v) for v in self.unresolved.values())
+        return {"functions": len(self.nodes), "edges": resolved,
+                "unresolved_calls": unresolved}
+
+
+def iter_own_scope(fn):
+    """Yield the nodes of ``fn``'s own scope: every descendant except the
+    bodies of nested ``def``/``lambda``s (those are their own graph
+    nodes). Nested ``FunctionDef``s themselves ARE yielded (so callers
+    can record ``defines`` edges) but not descended into."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

@@ -3,6 +3,7 @@
 ``run(ctx) -> iterable[Finding]`` over the whole tree context."""
 
 from .env_registry import EnvRegistryChecker
+from .exception_swallow import ExceptionSwallowChecker
 from .host_sync import HostSyncChecker
 from .lock_discipline import LockDisciplineChecker
 from .telemetry_catalog import TelemetryCatalogChecker
@@ -15,6 +16,7 @@ ALL_CHECKERS = [
     EnvRegistryChecker,
     TelemetryCatalogChecker,
     LockDisciplineChecker,
+    ExceptionSwallowChecker,
     TyposChecker,
 ]
 

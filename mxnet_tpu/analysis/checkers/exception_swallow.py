@@ -1,0 +1,128 @@
+"""exception-swallow: no silently-dropped exceptions in worker loops.
+
+Every threaded plane in this tree — the DecodePool workers, the
+DynamicBatcher dispatch loop, the replica supervision pass, the async
+checkpoint writer — runs a ``while`` loop on a daemon thread. A bare
+``except:`` / ``except Exception: pass`` inside such a loop turns a
+crash into a hang: the loop spins on (or worse, stops making progress)
+with nothing in the logs, nothing on telemetry, and the consumer blocked
+forever on a result that will never arrive. The chaos suites exist
+precisely because these hangs are the failure mode that escapes unit
+tests.
+
+Flagged: an ``except`` handler that (a) catches everything — bare,
+``Exception``, or ``BaseException`` — and (b) does nothing observable:
+its body contains no ``raise``, no logging/warnings call, no telemetry
+increment/record/event, no error hand-off (``set_exception``/``_store``/
+callback), and is (c) lexically inside a ``while`` loop — the
+worker/supervision pattern. One-shot ``try`` blocks outside loops (e.g.
+best-effort cleanup in ``close()``) are out of scope: a swallowed
+exception there loses one event, not liveness.
+
+Triage: make the swallow observable (telemetry counter, ``_log``,
+re-raise after cleanup) or carry a line pragma
+``# graftlint: allow=exception-swallow(<reason>)`` on the ``except``
+line when the silence is deliberate (e.g. double-close races in
+``__del__``-adjacent paths that genuinely may fire mid-interpreter
+teardown).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, dotted, iter_defs
+
+#: a call whose dotted name contains one of these marks the handler as
+#: observable — the exception is logged, counted, or handed somewhere.
+_OBSERVABLE_HINTS = (
+    "log", "warn", "print", "telemetry", "counter", "inc", "record",
+    "event", "emit", "set_exception", "set_result", "_store", "signal",
+    "report", "callback", "abort", "stop", "close", "shutdown",
+)
+
+_CATCH_ALL = {"Exception", "BaseException"}
+
+
+def _catches_all(handler):
+    if handler.type is None:
+        return "bare `except:`"
+    names = []
+    t = handler.type
+    elems = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elems:
+        names.append(dotted(e) or "?")
+    for n in names:
+        if n.rsplit(".", 1)[-1] in _CATCH_ALL:
+            return f"`except {n}:`"
+    return None
+
+
+def _is_observable(handler):
+    """True when the handler body does something a human or a metric can
+    see: re-raise, return/propagate the error object, log, count."""
+    for sub in ast.walk(handler):
+        if isinstance(sub, (ast.Raise, ast.Return)):
+            return True
+        if isinstance(sub, ast.Call):
+            name = (dotted(sub.func) or "").lower()
+            if any(h in name for h in _OBSERVABLE_HINTS):
+                return True
+            # the caught exception handed to ANY call is a hand-off
+            # (`self._store(..., exc)`, `_PrefetchError(exc)`), not a
+            # swallow — someone downstream sees it
+            if handler.name:
+                for arg in ast.walk(sub):
+                    if isinstance(arg, ast.Name) and arg.id == handler.name:
+                        return True
+        if isinstance(sub, (ast.Assign, ast.AugAssign)):
+            # stashing the exception somewhere (self.err = exc, etc.)
+            # counts as a hand-off, not a swallow
+            for v in ast.walk(sub):
+                if isinstance(v, ast.Name) and handler.name \
+                        and v.id == handler.name:
+                    return True
+    return False
+
+
+class ExceptionSwallowChecker:
+    name = "exception-swallow"
+    doc = ("catch-all `except` handlers that swallow the error inside "
+           "worker/supervision `while` loops — silent swallows turn "
+           "crashes into hangs; log, count, re-raise, or pragma")
+
+    def run(self, ctx):
+        for unit in ctx.units:
+            if unit.tree is None:
+                continue
+            for qual, _cls, fn in iter_defs(unit.tree):
+                yield from self._check_fn(unit, qual, fn)
+
+    def _check_fn(self, unit, qual, fn):
+        loops = []
+
+        def visit(node, in_loop):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue  # nested defs report under their own qual
+                if isinstance(child, ast.While):
+                    visit(child, True)
+                elif isinstance(child, ast.Try):
+                    if in_loop:
+                        loops.extend(child.handlers)
+                    visit(child, in_loop)
+                else:
+                    visit(child, in_loop)
+
+        visit(fn, False)
+        for handler in loops:
+            what = _catches_all(handler)
+            if what is None or _is_observable(handler):
+                continue
+            yield Finding(
+                self.name, unit.path, handler.lineno,
+                f"{what} swallows the error inside a worker loop — a "
+                "crash becomes a silent hang; log it, count it on "
+                "telemetry, re-raise, or pragma the deliberate drop",
+                context=qual)

@@ -1,24 +1,36 @@
-"""host-sync: no blocking device→host syncs inside declared hot paths.
+"""host-sync: no blocking device→host syncs *reachable* from hot roots.
 
 PR 1 made ``Module.fit``/``score`` run with zero per-batch host syncs and
 PR 5/7 extended the contract to the serving request path; the runtime
-counter tests verify it on the paths they drive. This checker enforces it
-lexically on every path: inside a *declared hot-path function* any call to
-``asnumpy`` / ``wait_to_read`` / ``block_until_ready`` / ``.item()`` or
-``np.asarray(...)`` (a disguised d2h copy when handed an NDArray) is a
-finding.
+counter tests verify it on the paths they drive. The PR-8 version of this
+checker enforced it lexically inside a table of declared hot functions —
+which meant a sync one call below a listed function shipped unseen, and
+the table rotted as the call tree grew.
 
-Hot paths are declared two ways:
+This version is whole-program: :data:`ROOTS` declares only the *entry
+points* of the hot planes (the fit/score epoch loops, the prefetch
+staging thread, the decode-pool consumer/worker loops, the serving
+submit/dispatch chain, bench's timed loop), and the call graph
+(:mod:`analysis.callgraph`) closes over everything they can reach. Any
+``asnumpy`` / ``wait_to_read`` / ``block_until_ready`` / ``.item()`` /
+``np.asarray`` in any transitively reached function is a finding, and the
+message carries the full root→function call chain so the reader sees WHY
+the function is hot.
 
-- the :data:`HOT_PATHS` table below — path -> set of function qualnames
-  (the fit/score epoch loops, the prefetch staging thread, the serving
-  batcher/replica dispatch chain, bench's timed step loop);
+Declaring hotness:
+
+- :data:`ROOTS` below — path -> set of root function qualnames;
 - a ``# graftlint: hotpath`` marker comment on (or directly above) any
-  ``def`` — how new hot paths opt in without touching this file.
+  ``def`` — how new thread bodies/entry points opt in without touching
+  this file.
 
-A *deliberate* sync (an epoch-boundary drain, bench's fence) carries a
-line pragma with its reason — the point is that every sync on a hot path
-is either a bug or an explained decision.
+Cutting reachability (the triage workflow): a *deliberate* cold boundary
+— an epoch-end checkpoint, a metric drain — is declared by putting a
+``# graftlint: allow=host-sync(<reason>)`` pragma on the **call site**
+that crosses into cold code; edges leaving a pragma-carrying line are not
+followed, so one annotation covers the whole cold subtree. A deliberate
+sync *on* the hot path itself carries the same pragma on its own line,
+exactly as before.
 """
 
 from __future__ import annotations
@@ -27,33 +39,28 @@ import ast
 
 from ..core import Finding, dotted, iter_defs
 
-#: repo-relative path -> hot function qualnames in that file.
-HOT_PATHS = {
+#: repo-relative path -> hot ROOT function qualnames in that file. Keep
+#: this list to entry points only (thread bodies, public loop drivers) —
+#: everything they call is covered by reachability, so helpers never
+#: need to be listed (that rot is what killed the old HOT_PATHS table).
+ROOTS = {
     "mxnet_tpu/module/base_module.py": {
-        "BaseModule.fit", "BaseModule.score", "BaseModule.forward_backward",
-    },
-    "mxnet_tpu/module/module.py": {
-        "Module.forward", "Module.backward", "Module.update",
-        "Module.train_window", "Module.update_metric",
+        "BaseModule.fit", "BaseModule.score",
     },
     "mxnet_tpu/io.py": {
-        "DevicePrefetchIter.next", "DevicePrefetchIter.iter_next",
-        "DevicePrefetchIter._worker", "DevicePrefetchIter._stage",
-        "DevicePrefetchIter._put",
+        "DevicePrefetchIter._worker",
+    },
+    "mxnet_tpu/io_plane.py": {
+        "DecodePool.next_result", "_worker_loop",
     },
     "mxnet_tpu/serving/batcher.py": {
-        "DynamicBatcher.submit", "DynamicBatcher._take",
-        "DynamicBatcher._run", "DynamicBatcher._run_batch",
-        "DynamicBatcher._dispatch_task",
-        "DynamicBatcher._execute_and_scatter",
+        "DynamicBatcher.submit", "DynamicBatcher._run",
     },
     "mxnet_tpu/serving/replica.py": {
-        "Replica.submit", "Replica._call", "ReplicaPool.run_batch",
-        "ReplicaPool._submit", "ReplicaPool._execute",
+        "ReplicaPool.run_batch",
     },
     "mxnet_tpu/serving/server.py": {
-        "ModelServer.submit", "ModelServer.predict", "ModelServer._infer",
-        "ModelServer._coerce",
+        "ModelServer.submit",
     },
     "bench.py": {
         "main.run_steps",
@@ -61,49 +68,89 @@ HOT_PATHS = {
 }
 
 _SYNC_ATTRS = {"asnumpy", "wait_to_read", "block_until_ready", "item"}
+_ASARRAY = ("np.asarray", "numpy.asarray", "np.array", "numpy.array")
 
 
 class HostSyncChecker:
     name = "host-sync"
     doc = ("blocking device→host syncs (`asnumpy`/`wait_to_read`/"
-           "`block_until_ready`/`.item()`/`np.asarray`) inside declared "
-           "hot-path functions")
+           "`block_until_ready`/`.item()`/`np.asarray`) anywhere "
+           "reachable from the declared hot roots — findings carry the "
+           "root→function call chain")
 
     def run(self, ctx):
-        for unit in ctx.units:
-            if unit.tree is None:
+        graph = ctx.callgraph()
+        by_path = {u.path: u for u in ctx.units}
+
+        roots = []
+        for path in sorted(ROOTS):
+            for qual in sorted(ROOTS[path]):
+                node = graph.node_for(path, qual)
+                if node is not None:
+                    roots.append(node.node_id)
+        roots.extend(self._marked_roots(ctx, graph))
+
+        def follow(caller, site):
+            # a host-sync pragma on a call-site line declares a deliberate
+            # cold boundary: edges leaving that line are not followed
+            unit = by_path.get(caller.path)
+            if unit is None:
+                return True
+            return "host-sync" not in unit.line_allows.get(site.line, {})
+
+        chains = graph.reachable(roots, edge_filter=follow)
+        for node_id in sorted(chains):
+            node = graph.nodes[node_id]
+            unit = by_path.get(node.path)
+            if unit is None:
                 continue
-            declared = HOT_PATHS.get(unit.path, set())
-            for qual, _cls, fn in iter_defs(unit.tree):
-                if qual in declared or self._marked(unit, fn):
-                    yield from self._check_fn(unit, qual, fn)
+            yield from self._check_fn(unit, graph, node, chains[node_id])
 
     @staticmethod
-    def _marked(unit, fn):
-        # marker on the def line, or on the line directly above it
-        deco_top = min([fn.lineno]
-                       + [d.lineno for d in fn.decorator_list])
-        return (fn.lineno in unit.hotpath_lines
-                or deco_top - 1 in unit.hotpath_lines)
-
-    def _check_fn(self, unit, qual, fn):
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
+    def _marked_roots(ctx, graph):
+        """Functions opted in via ``# graftlint: hotpath`` markers."""
+        for unit in ctx.units:
+            if unit.tree is None or not unit.hotpath_lines:
                 continue
-            callee = dotted(node.func)
-            if isinstance(node.func, ast.Attribute) \
-                    and node.func.attr in _SYNC_ATTRS:
+            for qual, _cls, fn in iter_defs(unit.tree):
+                deco_top = min([fn.lineno]
+                               + [d.lineno for d in fn.decorator_list])
+                if fn.lineno in unit.hotpath_lines \
+                        or deco_top - 1 in unit.hotpath_lines:
+                    node = graph.node_for(unit.path, qual)
+                    if node is not None:
+                        yield node.node_id
+
+    @staticmethod
+    def _chain_text(graph, chain):
+        names = [graph.nodes[n].dotted.replace("mxnet_tpu.", "", 1)
+                 for n in chain]
+        if len(names) == 1:
+            return f"hot root `{names[0]}`"
+        return (f"reachable from hot root `{names[0]}` via "
+                + " -> ".join(f"`{n}`" for n in names[1:]))
+
+    def _check_fn(self, unit, graph, node, chain):
+        from ..callgraph import iter_own_scope
+
+        where = self._chain_text(graph, chain)
+        for sub in iter_own_scope(node.fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            callee = dotted(sub.func)
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _SYNC_ATTRS:
                 yield Finding(
-                    self.name, unit.path, node.lineno,
-                    f"blocking host sync `.{node.func.attr}()` inside "
-                    "hot path — keep device work async or pragma the "
+                    self.name, unit.path, sub.lineno,
+                    f"blocking host sync `.{sub.func.attr}()` on a hot "
+                    f"path ({where}) — keep device work async, cut the "
+                    "chain at a deliberate cold boundary, or pragma the "
                     "deliberate fence",
-                    context=qual)
-            elif callee in ("np.asarray", "numpy.asarray", "np.array",
-                            "numpy.array"):
+                    context=node.qual)
+            elif callee in _ASARRAY:
                 yield Finding(
-                    self.name, unit.path, node.lineno,
-                    f"`{callee}(...)` inside hot path is a device→host "
-                    "copy when handed an NDArray — stage on device or "
-                    "pragma the deliberate fetch",
-                    context=qual)
+                    self.name, unit.path, sub.lineno,
+                    f"`{callee}(...)` on a hot path ({where}) is a "
+                    "device→host copy when handed an NDArray — stage on "
+                    "device or pragma the deliberate fetch",
+                    context=node.qual)

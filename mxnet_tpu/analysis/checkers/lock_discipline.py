@@ -1,38 +1,41 @@
-"""lock-discipline: RacerD-flavoured lock-set analysis for the threaded
-subsystems (serving/*, kvstore*, checkpoint).
+"""lock-discipline: RacerD-flavoured interprocedural lock-set analysis,
+tree-wide.
 
-The replicated serving stack is a web of locks — the batcher's condition
-and run lock, per-replica locks, the pool health lock — kept deadlock-free
-today by convention and the chaos suite. This checker makes the convention
-mechanical. Per scoped file it discovers lock attributes
-(``self.x = threading.Lock()/RLock()/Condition()/Semaphore()`` and
-module-level equivalents), computes per-method lock sets from ``with``
-regions and ``.acquire()`` calls, resolves same-class method calls made
-while holding a lock, and reports:
+The reference's C++ core gets its concurrency safety from a dependency
+engine that serializes every mutation by design; our port replaced that
+with free-form Python threading — the serving replica pool, the
+DynamicBatcher, the async checkpoint writer, the PR-14 DecodePool — held
+deadlock-free by convention and the chaos suites. The PR-8 version of
+this checker made the convention mechanical but only *within one class*
+and only for three subsystems; this version is whole-program: lock sets
+propagate through the project call graph (:mod:`analysis.callgraph`), so
+an ABBA pair split across two classes, or a blocking call two frames
+below the lock, is reported at the call site that creates it.
 
-- **acquisition-order cycles** in the resulting lock graph (lock L taken
-  while holding M somewhere, M while holding L elsewhere — the classic
-  ABBA deadlock), including re-acquiring a non-reentrant ``Lock`` under
-  itself;
-- **mixed guarded/unguarded mutation**: a field written both under a lock
-  and outside any lock (outside ``__init__``) — either the lock is
-  unnecessary or the unguarded write is a race;
-- **blocking work under the batcher run lock**: device calls
-  (``forward``/``run``/``asnumpy``/``wait_to_read``/``block_until_ready``)
-  or future resolution (``set_result``/``set_exception``) while holding a
-  lock named ``run_lock`` — the single-worker serving loop stalls every
-  queued request for the duration;
-- **I/O under an async-writer hand-off lock**: file I/O (``open``/
-  ``savez``/``fsync``/``rename``/...) or device calls while holding a
-  lock named ``*writer_lock`` — the async checkpoint writer's
-  bounded-stall contract says the hand-off lock guards only the pending
-  slot; holding it across a write re-serializes training against the
-  very I/O the writer thread exists to overlap.
+Discovered primitives (``self.x = threading.Lock()`` and friends, plus
+module-level equivalents): ``Lock``/``RLock``/``Condition``/
+``Semaphore``/``BoundedSemaphore`` participate in lock sets;
+``Event``/``queue.Queue`` are *blocking* primitives. Lock identity is
+``Class.attr`` for instance locks and ``module.attr`` for globals; a
+lock attribute on a foreign receiver (``rep.lock``) resolves to the
+unique tree class declaring that attribute when there is exactly one.
 
-Lock identity is ``Class.attr`` for ``self`` locks and module-qualified
-for globals; a lock attribute seen on a foreign receiver (``rep.lock``)
-resolves to the unique scoped class declaring that attribute when there
-is exactly one.
+Reported, with lock sets flowing through call edges:
+
+- **acquisition-order cycles** (the classic ABBA deadlock) in the global
+  lock graph, including cycles whose two halves live in different
+  classes/modules, and **non-reentrant re-acquisition** — directly or
+  through any resolved call chain;
+- **mixed guarded/unguarded mutation**: a field written both under a
+  lock and outside any lock (outside ``__init__``);
+- **blocking under a lock**: ``Event.wait``, ``Condition.wait`` while
+  *other* locks stay held (a condition releases only itself), blocking
+  ``queue.get``/``put`` — direct or via a call into a function that
+  blocks;
+- **blocking work under the batcher run lock** (device calls, future
+  resolution) and **I/O under an async-writer hand-off lock** — the
+  PR-8 rules, now also caught when the blocking work hides one call
+  down.
 """
 
 from __future__ import annotations
@@ -41,12 +44,10 @@ import ast
 
 from ..core import Finding, dotted, root_name
 
-_SCOPE_PREFIXES = ("mxnet_tpu/serving/",)
-_SCOPE_FILES = ("mxnet_tpu/kvstore.py", "mxnet_tpu/kvstore_async.py",
-                "mxnet_tpu/checkpoint.py")
-
 _LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore",
                "BoundedSemaphore"}
+_EVENT_TYPES = {"Event"}
+_QUEUE_TYPES = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
 _BLOCKING_ATTRS = {"forward", "run", "asnumpy", "wait_to_read",
                    "block_until_ready"}
 _FUTURE_ATTRS = {"set_result", "set_exception"}
@@ -55,209 +56,430 @@ _WRITER_IO_ATTRS = {"savez", "save", "dump", "write", "flush", "fsync",
 _SKIP_METHODS = {"__init__", "__del__"}
 
 
-def in_scope(path):
-    if path.startswith(_SCOPE_PREFIXES) or path in _SCOPE_FILES:
-        return True
-    # out-of-tree files (explicit CLI paths, checker fixtures) are always
-    # fair game; inside the framework scope the subsystem list above is
-    # authoritative — single-threaded modules would only produce noise
-    return not path.startswith(("mxnet_tpu/", "bench.py"))
-
-
-def _lock_ctor(value):
-    """'Lock'/'RLock'/... when ``value`` constructs a threading primitive."""
-    if isinstance(value, ast.Call):
-        callee = dotted(value.func) or ""
-        tail = callee.rsplit(".", 1)[-1]
-        if tail in _LOCK_TYPES and (callee.startswith("threading.")
-                                    or callee == tail):
-            return tail
+def _prim_ctor(value):
+    """('lock'|'event'|'queue', type name) when ``value`` constructs a
+    known threading/queue primitive, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    callee = dotted(value.func) or ""
+    tail = callee.rsplit(".", 1)[-1]
+    head = callee.split(".", 1)[0]
+    if tail in _LOCK_TYPES and (head == "threading" or callee == tail):
+        return ("lock", tail)
+    if tail in _EVENT_TYPES and (head == "threading" or callee == tail):
+        return ("event", tail)
+    if tail in _QUEUE_TYPES and (head == "queue" or callee == tail):
+        return ("queue", tail)
     return None
 
 
 class _ClassInfo:
-    def __init__(self, module, name, node):
-        self.module = module
-        self.name = name
-        self.node = node
-        self.locks = {}        # attr -> lock type name
-        self.method_locks = {}  # method name -> set of lock node ids
-        self.guarded_writes = {}    # field -> first (line,)
-        self.unguarded_writes = {}  # field -> first (line, method)
+    __slots__ = ("path", "name", "prims", "guarded_writes",
+                 "unguarded_writes")
 
-    def lock_id(self, attr):
+    def __init__(self, path, name):
+        self.path = path
+        self.name = name
+        self.prims = {}             # attr -> (category, type name)
+        self.guarded_writes = {}    # field -> (line,)
+        self.unguarded_writes = {}  # field -> (line, method qual)
+
+    def prim_id(self, attr):
         return f"{self.name}.{attr}"
 
 
 class LockDisciplineChecker:
     name = "lock-discipline"
-    doc = ("lock-acquisition-order cycles across serving/kvstore/"
-           "checkpoint, fields mutated both under and outside a lock, "
-           "and blocking device calls or future resolution while holding "
-           "the batcher run lock")
+    doc = ("interprocedural lock-set analysis over the whole tree: "
+           "acquisition-order cycles (ABBA) across classes and modules, "
+           "non-reentrant re-acquisition through call chains, mixed "
+           "guarded/unguarded field writes, and blocking work "
+           "(Event/Condition/queue waits, device calls, future "
+           "resolution, file I/O) while holding a lock")
+
+    # ------------------------------------------------------------- run
 
     def run(self, ctx):
-        classes = []       # all _ClassInfo across scoped files
-        edges = {}         # lock id -> {held-> set of (unit, line)}
-        findings = []
-        per_unit = []
+        graph = ctx.callgraph()
+        self.graph = graph
+        self.findings = []
+        self.edges = {}           # lock -> {next lock -> [(path, line)]}
+        self.classes = {}         # (path, class name) -> _ClassInfo
+        self.mod_prims = {}       # (path, var name) -> (id, cat, type)
+        self.attr_owner = {}      # attr -> [_ClassInfo]
+        self.kinds = {}           # prim id -> type name
+
         for unit in ctx.units:
-            if unit.tree is None or not in_scope(unit.path):
+            if unit.tree is None:
                 continue
-            infos = self._collect_classes(unit)
-            classes.extend((unit, info) for info in infos)
-            per_unit.append((unit, infos))
+            self._discover(unit)
 
-        # attr -> classes declaring it (for foreign-receiver resolution)
-        attr_owner = {}
-        for _unit, info in classes:
-            for attr in info.locks:
-                attr_owner.setdefault(attr, []).append(info)
+        # call-site index: (caller node id, line) -> [callee node ids]
+        self.calls_at = {}
+        for caller_id, sites in graph.edges.items():
+            for s in sites:
+                if s.kind == "call":
+                    self.calls_at.setdefault(
+                        (caller_id, s.line), []).append(s.callee)
 
-        for unit, infos in per_unit:
-            for info in infos:
-                self._analyze_class(unit, info, attr_owner, edges, findings)
+        # pass 1: per-function direct acquire/blocking summaries
+        self.direct_acq = {}      # node id -> set of lock ids
+        self.direct_blk = {}      # node id -> set of (kind, desc)
+        for node_id in sorted(graph.nodes):
+            self._summarize(graph.nodes[node_id])
 
-        findings.extend(self._cycles(edges, classes))
-        return findings
+        # transitive closure over call edges (defines-edges excluded:
+        # defining a closure acquires nothing)
+        self.trans_acq = self._propagate(self.direct_acq)
+        self.trans_blk = self._propagate(self.direct_blk)
 
-    # -- discovery -----------------------------------------------------
-    def _collect_classes(self, unit):
-        infos = []
+        # pass 2: findings + order edges with full held sets
+        for node_id in sorted(graph.nodes):
+            self._analyze(graph.nodes[node_id])
+
+        for info in sorted(self.classes.values(),
+                           key=lambda i: (i.path, i.name)):
+            self._mixed_writes(info)
+
+        self.findings.extend(self._cycles(self.edges))
+        out, self.findings = self.findings, []
+        self.graph = None
+        return out
+
+    # ------------------------------------------------------- discovery
+
+    def _discover(self, unit):
+        modtail = unit.path.rsplit("/", 1)[-1][:-3] \
+            if unit.path.endswith(".py") else unit.path
         for node in unit.tree.body:
-            if not isinstance(node, ast.ClassDef):
-                continue
-            info = _ClassInfo(unit.path, node.name, node)
-            for sub in ast.walk(node):
-                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
-                    t = sub.targets[0]
-                    kind = _lock_ctor(sub.value)
-                    if kind and isinstance(t, ast.Attribute) \
-                            and root_name(t) == "self":
-                        info.locks[t.attr] = kind
-            infos.append(info)
-        return infos
+            if isinstance(node, ast.ClassDef):
+                info = _ClassInfo(unit.path, node.name)
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) \
+                            and len(sub.targets) == 1:
+                        t = sub.targets[0]
+                        prim = _prim_ctor(sub.value)
+                        if prim and isinstance(t, ast.Attribute) \
+                                and root_name(t) == "self":
+                            info.prims[t.attr] = prim
+                            self.kinds[info.prim_id(t.attr)] = prim[1]
+                self.classes[(unit.path, node.name)] = info
+                for attr in info.prims:
+                    self.attr_owner.setdefault(attr, []).append(info)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                prim = _prim_ctor(node.value)
+                if prim:
+                    name = node.targets[0].id
+                    pid = f"{modtail}.{name}"
+                    self.mod_prims[(unit.path, name)] = (pid,) + prim
+                    self.kinds[pid] = prim[1]
 
-    # -- per-class analysis --------------------------------------------
-    def _analyze_class(self, unit, info, attr_owner, edges, findings):
-        methods = [n for n in info.node.body
-                   if isinstance(n, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef))]
-        # first pass: lock sets per method (locks it takes at any depth,
-        # including through same-class calls). Iterated to a fixpoint so
-        # an unlocked delegating method defined BEFORE its locking callee
-        # still imports the callee's locks — definition order must not
-        # decide whether a cycle is visible.
-        while True:
+    def _class_of(self, node):
+        if node.cls is None:
+            return None
+        return self.classes.get((node.path, node.cls))
+
+    def _resolve_prim(self, node, expr):
+        """(prim id, category) for an expression naming a discovered
+        primitive, else None. ``node`` is the enclosing FuncNode."""
+        if isinstance(expr, ast.Name):
+            hit = self.mod_prims.get((node.path, expr.id))
+            if hit is not None:
+                return hit[0], hit[1]
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        base = root_name(expr)
+        attr = expr.attr
+        if base == "self":
+            info = self._class_of(node)
+            if info is not None and attr in info.prims:
+                return info.prim_id(attr), info.prims[attr][0]
+            return None
+        owners = self.attr_owner.get(attr, [])
+        if len(owners) == 1:
+            return owners[0].prim_id(attr), owners[0].prims[attr][0]
+        if owners:
+            return f"*.{attr}", owners[0].prims[attr][0]
+        return None
+
+    def _lock_kind(self, lock_id):
+        return self.kinds.get(lock_id)
+
+    # ------------------------------------------------------- summaries
+
+    def _summarize(self, node):
+        acq, blk = set(), set()
+
+        def on_acquire(lock, stmt, held):
+            acq.add(lock)
+
+        def on_call(call, held):
+            func = call.func
+            if isinstance(func, ast.Attribute) and func.attr == "acquire":
+                prim = self._resolve_prim(node, func.value)
+                if prim is not None and prim[1] == "lock":
+                    acq.add(prim[0])
+                    return
+            for kind, desc in self._direct_blocking(node, call, held):
+                blk.add((kind, desc))
+
+        self._walk(node.fn.body, [], on_acquire, on_call, None, node)
+        self.direct_acq[node.node_id] = acq
+        self.direct_blk[node.node_id] = blk
+
+    def _propagate(self, direct):
+        """Transitive closure of per-function summaries over resolved
+        call edges, to a fixpoint."""
+        trans = {k: set(v) for k, v in direct.items()}
+        changed = True
+        while changed:
             changed = False
-            for m in methods:
-                taken = set()
-                self._walk(unit, info, attr_owner, m, m.body, [], taken,
-                           None, None)
-                if taken != info.method_locks.get(m.name):
-                    info.method_locks[m.name] = taken
+            for caller_id in self.graph.edges:
+                cur = trans.setdefault(caller_id, set())
+                before = len(cur)
+                for site in self.graph.edges[caller_id]:
+                    if site.kind != "call":
+                        continue
+                    cur |= trans.get(site.callee, set())
+                if len(cur) != before:
                     changed = True
-            if not changed:
-                break
-        # second pass: edges + mutations + run-lock rule, with held sets
-        for m in methods:
-            self._walk(unit, info, attr_owner, m, m.body, [], None,
-                       edges, findings)
-        # mixed guarded/unguarded mutation
+        return trans
+
+    def _direct_blocking(self, node, call, held):
+        """Yield (kind, desc) blocking events performed by this call
+        itself (receiver-resolved waits and queue ops). ``held`` only
+        matters for the Condition self-exemption."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id == "open":
+                yield ("io", "open(...)")
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        attr = func.attr
+        if attr == "wait":
+            prim = self._resolve_prim(node, func.value)
+            if prim is None:
+                return
+            pid, cat = prim
+            if cat == "event":
+                yield ("event_wait", pid)
+            elif cat == "lock" and self._lock_kind(pid) == "Condition":
+                # waiting on a condition releases only that condition
+                yield ("cond_wait", pid)
+        elif attr in ("get", "put"):
+            prim = self._resolve_prim(node, func.value)
+            if prim is not None and prim[1] == "queue":
+                yield ("queue_" + attr, prim[0])
+        elif attr in _BLOCKING_ATTRS:
+            yield ("device", f".{attr}(...)")
+        elif attr in _FUTURE_ATTRS:
+            yield ("future", f".{attr}(...)")
+        elif attr in _WRITER_IO_ATTRS:
+            yield ("io", f".{attr}(...)")
+
+    # --------------------------------------------------------- pass 2
+
+    def _analyze(self, node):
+        nid = node.node_id
+
+        def on_acquire(lock, stmt, held):
+            self._note_acquire(node, stmt, lock, held)
+
+        def on_call(call, held):
+            self._check_call(node, call, held)
+
+        def on_write(stmt, held):
+            self._note_write(node, stmt, held)
+
+        self._walk(node.fn.body, [], on_acquire, on_call, on_write, node)
+
+    def _note_acquire(self, node, at, lock, held, via=None):
+        suffix = f" (via call to `{via}`)" if via else ""
+        for h in held:
+            if h == lock:
+                kind = self._lock_kind(lock)
+                if kind in ("Lock", "Semaphore", "BoundedSemaphore"):
+                    self.findings.append(Finding(
+                        self.name, node.path, at.lineno,
+                        f"non-reentrant {kind} `{lock}` re-acquired "
+                        f"while already held — self-deadlock{suffix}",
+                        context=node.qual))
+                continue
+            self.edges.setdefault(h, {}).setdefault(lock, []).append(
+                (node.path, at.lineno))
+
+    def _check_call(self, node, call, held):
+        func = call.func
+        # explicit .acquire(): an acquisition event
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            prim = self._resolve_prim(node, func.value)
+            if prim is not None and prim[1] == "lock":
+                self._note_acquire(node, call, prim[0], held)
+            return
+
+        # locks/blocking imported from resolved callees
+        callee_name = None
+        for callee_id in self.calls_at.get((node.node_id, call.lineno),
+                                           ()):
+            callee = self.graph.nodes[callee_id]
+            callee_name = callee.dotted.replace("mxnet_tpu.", "", 1)
+            if held:
+                for lock in sorted(self.trans_acq.get(callee_id, ())):
+                    self._note_acquire(node, call, lock, held,
+                                       via=callee_name)
+                for kind, desc in sorted(
+                        self.trans_blk.get(callee_id, ())):
+                    self._blocking_finding(node, call, held, kind, desc,
+                                           via=callee_name)
+
+        if not held:
+            return
+        for kind, desc in self._direct_blocking(node, call, held):
+            self._blocking_finding(node, call, held, kind, desc)
+
+    def _blocking_finding(self, node, call, held, kind, desc, via=None):
+        where = f" inside `{via}`" if via else ""
+        others = [h for h in held if h != desc]
+        if kind == "cond_wait":
+            # waiting on a condition you hold is the normal pattern —
+            # the hazard is every OTHER lock staying held across it
+            if not others:
+                return
+            self.findings.append(Finding(
+                self.name, node.path, call.lineno,
+                f"`{desc}.wait()`{where} releases only itself — "
+                f"lock `{others[0]}` stays held across the wait "
+                "(lock-ordering stall / missed-wakeup deadlock)",
+                context=node.qual))
+        elif kind == "event_wait":
+            self.findings.append(Finding(
+                self.name, node.path, call.lineno,
+                f"blocking `{desc}.wait()`{where} while holding lock "
+                f"`{held[0]}` — the setter may need that lock; wait "
+                "after releasing it",
+                context=node.qual))
+        elif kind in ("queue_get", "queue_put"):
+            op = kind.split("_")[1]
+            self.findings.append(Finding(
+                self.name, node.path, call.lineno,
+                f"blocking queue `.{op}()` on `{desc}`{where} while "
+                f"holding lock `{held[0]}` — producers/consumers that "
+                "need the lock deadlock against it",
+                context=node.qual))
+        elif kind == "device" \
+                and any(h.endswith(".run_lock") for h in held):
+            self.findings.append(Finding(
+                self.name, node.path, call.lineno,
+                f"blocking device call `{desc}`{where} while holding "
+                "the batcher run lock stalls every queued request",
+                context=node.qual))
+        elif kind == "future" \
+                and any(h.endswith(".run_lock") for h in held):
+            self.findings.append(Finding(
+                self.name, node.path, call.lineno,
+                f"`{desc}`{where} while holding the batcher run lock — "
+                "client callbacks run under the lock (resolve futures "
+                "after releasing it)",
+                context=node.qual))
+        elif kind in ("io", "device") \
+                and any(h.endswith("writer_lock") for h in held):
+            self.findings.append(Finding(
+                self.name, node.path, call.lineno,
+                f"`{desc}`{where} while holding the writer hand-off "
+                "lock — the lock guards only the pending slot; do the "
+                "I/O after releasing it or the training thread stalls "
+                "behind the write",
+                context=node.qual))
+
+    def _note_write(self, node, stmt, held):
+        info = self._class_of(node)
+        if info is None:
+            return
+        method = node.qual.split(".")[-1]
+        if method in _SKIP_METHODS:
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and root_name(t) == "self" \
+                    and isinstance(t.value, ast.Name):
+                if held:
+                    info.guarded_writes.setdefault(t.attr, (stmt.lineno,))
+                else:
+                    info.unguarded_writes.setdefault(
+                        t.attr, (stmt.lineno, node.qual))
+
+    def _mixed_writes(self, info):
         for field_name, (g_line,) in sorted(info.guarded_writes.items()):
             if field_name in info.unguarded_writes:
-                u_line, u_method = info.unguarded_writes[field_name]
-                findings.append(Finding(
-                    self.name, unit.path, u_line,
-                    f"field `self.{field_name}` of {info.name} is written "
-                    f"both under a lock (line {g_line}) and outside any "
-                    "lock — either drop the lock or guard this write",
-                    context=f"{info.name}.{u_method}"))
+                u_line, u_qual = info.unguarded_writes[field_name]
+                self.findings.append(Finding(
+                    self.name, info.path, u_line,
+                    f"field `self.{field_name}` of {info.name} is "
+                    f"written both under a lock (line {g_line}) and "
+                    "outside any lock — either drop the lock or guard "
+                    "this write",
+                    context=u_qual))
 
-    def _resolve_lock(self, info, attr_owner, node):
-        """A lock node id for an expression that names a lock, or None."""
-        if not isinstance(node, ast.Attribute):
-            return None
-        base = root_name(node)
-        attr = node.attr
-        if base == "self":
-            if attr in info.locks:
-                return info.lock_id(attr)
-            return None
-        owners = attr_owner.get(attr, [])
-        if len(owners) == 1:
-            return owners[0].lock_id(attr)
-        if owners:
-            return f"*.{attr}"
-        return None
+    # ----------------------------------------------------------- walk
 
-    def _lock_kind(self, lock_id, attr_owner):
-        cls, _, attr = lock_id.partition(".")
-        for owners in attr_owner.values():
-            for info in owners:
-                if info.name == cls and attr in info.locks:
-                    return info.locks[attr]
-        return None
-
-    def _walk(self, unit, info, attr_owner, method, body, held, taken,
-              edges, findings):
-        """One traversal serving both passes: ``taken`` collects this
-        method's lock set (pass 1); ``edges``/``findings`` record order
-        edges, run-lock violations and writes (pass 2)."""
+    def _walk(self, body, held, on_acquire, on_call, on_write, node):
         for stmt in body:
             if isinstance(stmt, ast.With):
                 inner = list(held)
                 for item in stmt.items:
-                    lock = self._resolve_lock(info, attr_owner,
-                                              item.context_expr)
-                    if lock is None:
+                    prim = self._resolve_prim(node, item.context_expr)
+                    if prim is None or prim[1] != "lock":
+                        # `with q.mutex:`-style misc context managers
+                        # and non-lock prims contribute nothing
+                        for sub in ast.walk(item.context_expr):
+                            if isinstance(sub, ast.Call):
+                                on_call(sub, inner)
                         continue
-                    self._note_acquire(unit, info, attr_owner, stmt, lock,
-                                       inner, taken, edges, findings)
-                    inner = inner + [lock]
-                self._walk(unit, info, attr_owner, method, stmt.body,
-                           inner, taken, edges, findings)
+                    on_acquire(prim[0], stmt, inner)
+                    inner = inner + [prim[0]]
+                self._walk(stmt.body, inner, on_acquire, on_call,
+                           on_write, node)
                 continue
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                # a nested def does not run here; analyze it lock-free
-                self._walk(unit, info, attr_owner, method, stmt.body,
-                           [], taken, edges, findings)
-                continue
-            for node in self._shallow_walk(stmt):
-                if isinstance(node, ast.Call):
-                    self._check_call(unit, info, attr_owner, method, node,
-                                     held, taken, edges, findings)
-                elif findings is not None and isinstance(
-                        node, (ast.Assign, ast.AugAssign)):
-                    self._note_write(info, method, node, held)
+                continue  # a nested def is its own graph node
+            for sub in self._shallow_walk(stmt):
+                if isinstance(sub, ast.Call):
+                    on_call(sub, held)
+                elif on_write is not None and isinstance(
+                        sub, (ast.Assign, ast.AugAssign)):
+                    on_write(sub, held)
             for attr_name in ("body", "orelse", "finalbody"):
-                sub = getattr(stmt, attr_name, None)
-                if sub and isinstance(sub, list) \
-                        and not isinstance(stmt, ast.With):
-                    self._walk(unit, info, attr_owner, method, sub, held,
-                               taken, edges, findings)
+                blk = getattr(stmt, attr_name, None)
+                if blk and isinstance(blk, list):
+                    self._walk(blk, held, on_acquire, on_call, on_write,
+                               node)
             for handler in getattr(stmt, "handlers", []) or []:
-                self._walk(unit, info, attr_owner, method, handler.body,
-                           held, taken, edges, findings)
+                self._walk(handler.body, held, on_acquire, on_call,
+                           on_write, node)
 
     @staticmethod
     def _shallow_walk(stmt):
-        """Expression-level nodes of ``stmt`` without descending into its
-        statement blocks (those are walked with the right held set)."""
+        """Expression-level nodes of ``stmt`` without descending into
+        its statement blocks (those are walked with the right held set)
+        or nested function bodies."""
         blocks = set()
         for attr_name in ("body", "orelse", "finalbody"):
-            sub = getattr(stmt, attr_name, None)
-            if isinstance(sub, list):
-                for s in sub:
+            blk = getattr(stmt, attr_name, None)
+            if isinstance(blk, list):
+                for s in blk:
                     blocks.add(id(s))
         for handler in getattr(stmt, "handlers", []) or []:
             blocks.add(id(handler))
 
         stack = [stmt]
         while stack:
-            node = stack.pop()
-            yield node
-            for child in ast.iter_child_nodes(node):
+            n = stack.pop()
+            yield n
+            for child in ast.iter_child_nodes(n):
                 if id(child) in blocks:
                     continue
                 if isinstance(child, (ast.FunctionDef,
@@ -265,108 +487,14 @@ class LockDisciplineChecker:
                     continue
                 stack.append(child)
 
-    def _note_acquire(self, unit, info, attr_owner, node, lock, held,
-                      taken, edges, findings):
-        if taken is not None:
-            taken.add(lock)
-        if edges is None:
-            return
-        for h in held:
-            if h == lock:
-                kind = self._lock_kind(lock, attr_owner)
-                if kind in ("Lock", "Semaphore", "BoundedSemaphore"):
-                    findings.append(Finding(
-                        self.name, unit.path, node.lineno,
-                        f"non-reentrant {kind} `{lock}` re-acquired while "
-                        "already held — self-deadlock",
-                        context=f"{info.name}"))
-                continue
-            edges.setdefault(h, {}).setdefault(lock, []).append(
-                (unit.path, node.lineno))
+    # --------------------------------------------------------- cycles
 
-    def _check_call(self, unit, info, attr_owner, method, node, held,
-                    taken, edges, findings):
-        callee = dotted(node.func)
-        # explicit .acquire() — an acquisition event (held-for-region
-        # tracking is not attempted; the order edge is what matters)
-        if isinstance(node.func, ast.Attribute) \
-                and node.func.attr == "acquire":
-            lock = self._resolve_lock(info, attr_owner, node.func.value)
-            if lock is not None:
-                self._note_acquire(unit, info, attr_owner, node, lock,
-                                   held, taken, edges, findings)
-            return
-        # same-class method call while holding: import its lock set
-        if callee and callee.startswith("self.") and "." not in callee[5:]:
-            target = callee[5:]
-            for lock in sorted(info.method_locks.get(target, ())):
-                self._note_acquire(unit, info, attr_owner, node, lock,
-                                   held, taken, edges, findings)
-        if findings is None or not held:
-            return
-        # blocking work under the batcher run lock
-        if any(h.endswith(".run_lock") for h in held) \
-                and isinstance(node.func, ast.Attribute):
-            attr = node.func.attr
-            if attr in _BLOCKING_ATTRS:
-                findings.append(Finding(
-                    self.name, unit.path, node.lineno,
-                    f"blocking device call `.{attr}(...)` while holding "
-                    "the batcher run lock stalls every queued request",
-                    context=f"{info.name}.{method.name}"))
-            elif attr in _FUTURE_ATTRS:
-                findings.append(Finding(
-                    self.name, unit.path, node.lineno,
-                    f"`.{attr}(...)` while holding the batcher run lock — "
-                    "client callbacks run under the lock (resolve futures "
-                    "after releasing it)",
-                    context=f"{info.name}.{method.name}"))
-        # I/O or device work under an async-writer hand-off lock: the
-        # bounded-stall contract says *writer_lock guards only the
-        # pending slot — release it before touching files or the device
-        if any(h.endswith("writer_lock") for h in held):
-            if isinstance(node.func, ast.Attribute):
-                attr = node.func.attr
-                if attr in _BLOCKING_ATTRS or attr in _WRITER_IO_ATTRS:
-                    findings.append(Finding(
-                        self.name, unit.path, node.lineno,
-                        f"`.{attr}(...)` while holding the writer "
-                        "hand-off lock — the lock guards only the "
-                        "pending slot; do the I/O after releasing it or "
-                        "the training thread stalls behind the write",
-                        context=f"{info.name}.{method.name}"))
-            elif isinstance(node.func, ast.Name) and node.func.id == "open":
-                findings.append(Finding(
-                    self.name, unit.path, node.lineno,
-                    "`open(...)` while holding the writer hand-off lock "
-                    "— the lock guards only the pending slot; do the I/O "
-                    "after releasing it or the training thread stalls "
-                    "behind the write",
-                    context=f"{info.name}.{method.name}"))
-
-    def _note_write(self, info, method, node, held):
-        if method.name in _SKIP_METHODS:
-            return
-        targets = node.targets if isinstance(node, ast.Assign) \
-            else [node.target]
-        for t in targets:
-            if isinstance(t, ast.Attribute) and root_name(t) == "self" \
-                    and isinstance(t.value, ast.Name):
-                field_name = t.attr
-                if held:
-                    info.guarded_writes.setdefault(
-                        field_name, (node.lineno,))
-                else:
-                    info.unguarded_writes.setdefault(
-                        field_name, (node.lineno, method.name))
-
-    # -- cycles --------------------------------------------------------
-    def _cycles(self, edges, classes):
+    def _cycles(self, edges):
         findings = []
         seen_cycles = set()
 
-        def dfs(start, node, path, visited):
-            for nxt in sorted(edges.get(node, {})):
+        def dfs(start, at, path, visited):
+            for nxt in sorted(edges.get(at, {})):
                 if nxt == start and len(path) > 1:
                     cyc = frozenset(path)
                     if cyc not in seen_cycles:

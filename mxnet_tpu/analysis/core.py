@@ -280,6 +280,15 @@ class TreeContext:
         self.root = root
         self.units = units
         self._docs = {}
+        self._callgraph = None
+
+    def callgraph(self):
+        """The whole-program :class:`~analysis.callgraph.CallGraph` over
+        this tree, built once and shared by every checker that asks."""
+        if self._callgraph is None:
+            from . import callgraph
+            self._callgraph = callgraph.CallGraph.build(self)
+        return self._callgraph
 
     def unit(self, path):
         for u in self.units:
@@ -339,6 +348,16 @@ def _load_units(root, files):
             continue
         units.append(SourceUnit(rel, src))
     return units
+
+
+def build_context(root, files=None):
+    """A :class:`TreeContext` over ``files`` (default: the framework
+    scope) without running any checker — the CLI's ``--callgraph`` debug
+    mode and ad-hoc analysis scripts start here."""
+    root = os.path.abspath(root)
+    units = _load_units(root, files if files is not None
+                        else default_files(root))
+    return TreeContext(root, units)
 
 
 def run_suite(root, files=None, checks=None, baseline=None):

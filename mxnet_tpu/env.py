@@ -428,6 +428,23 @@ _declare("MXNET_MESH_BACKEND", str, "",
          "jax backend whose devices back the MXNET_MESH mesh (e.g. 'cpu' "
          "to lay a virtual validation mesh over host cores while a TPU "
          "is attached). Empty (default) = the default backend.")
+_declare("MXNET_SANITIZER", int, 0,
+         "Arm the runtime concurrency sanitizer "
+         "(mxnet_tpu.analysis.sanitizer) process-wide: threading locks "
+         "are swapped for instrumented wrappers that maintain a "
+         "process-wide lock-order graph and report ABBA cycles with "
+         "both acquisition stacks (sanitizer.report()). 1 = arm via "
+         "sanitizer.maybe_install(). Under pytest the `sanitize`-marked "
+         "suites are instrumented by default; 0 there opts out. Read "
+         "raw by the sanitizer itself (it must work with the framework "
+         "absent); declared here so the catalogue stays complete.")
+_declare("MXNET_SANITIZER_HOLD_MS", float, 0.0,
+         "Held-too-long threshold for the runtime sanitizer: any "
+         "instrumented lock held longer than this many milliseconds is "
+         "reported with its acquire stack (who is starving the decode/"
+         "serving plane). 0 (default) disables hold tracking — the "
+         "acquire-path stack capture it needs is the expensive part of "
+         "the sanitizer.")
 _declare("MXNET_XLA_TPU_OPTIONS", str, "",
          "Comma-separated key=value XLA compiler options attached to every "
          "executor program when the target is a TPU (ignored on CPU). The "

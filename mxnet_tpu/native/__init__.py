@@ -134,9 +134,9 @@ def load_batch(path, offsets, data_shape, resize=-1, rand_crop=False,
     assert c == 3, "native plane decodes RGB"
     data = np.zeros((n, 3, h, w), np.float32)
     labels = np.zeros((n, label_width), np.float32)
-    mean = np.asarray(mean, np.float32)
-    std = np.asarray(std, np.float32)
-    extra = np.asarray(
+    mean = np.asarray(mean, np.float32)  # graftlint: allow=host-sync(host-side python list of aug constants — no device handle involved)
+    std = np.asarray(std, np.float32)  # graftlint: allow=host-sync(host-side python list of aug constants — no device handle involved)
+    extra = np.asarray(  # graftlint: allow=host-sync(host-side python floats for the native aug struct — no device handle involved)
         [float(aug.get(f, d))
          for f, d in zip(_AUG_EXTRA_FIELDS, _AUG_EXTRA_DEFAULTS)],
         np.float32)

@@ -566,7 +566,7 @@ def array(source_array, ctx=None, dtype=None):
         if dtype is not None:
             src = src.astype(np_dtype(dtype))
         return NDArray(_place(src, ctx), ctx)
-    arr = np.asarray(source_array, dtype=np_dtype(dtype) if dtype else None)
+    arr = np.asarray(source_array, dtype=np_dtype(dtype) if dtype else None)  # graftlint: allow=host-sync(NDArray inputs took the branch above; this converts host lists/numpy on the ingest path — no device handle involved)
     if arr.dtype == np.float64 and dtype is None:
         arr = arr.astype(np.float32)
     if arr.dtype == np.int64 and dtype is None and not isinstance(source_array, np.ndarray):

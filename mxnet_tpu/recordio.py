@@ -523,7 +523,7 @@ class ImageRecordIter:
         """Stack per-record (arr, label) pairs into batch arrays."""
         data = np.stack([r[0] for r in results])
         if self.label_width == 1:
-            label = np.array([np.ravel(r[1])[0] for r in results],
+            label = np.array([np.ravel(r[1])[0] for r in results],  # graftlint: allow=host-sync(labels come off the host decode plane as numpy — no device handle involved)
                              dtype=np.float32)
         else:
             label = np.stack(
@@ -598,7 +598,7 @@ class ImageRecordIter:
         extra = {k: v for k, v in self.aug.items() if k != "inter_method"}
         data, labels, ok = _native.load_batch(
             self.path_imgrec,
-            np.asarray(self._offsets, np.int64)[idxs],
+            np.asarray(self._offsets, np.int64)[idxs],  # graftlint: allow=host-sync(host-side record offsets list for the native decoder — no device handle involved)
             self.data_shape,
             resize=self.resize,
             rand_crop=self.rand_crop,

@@ -27,6 +27,7 @@ for _k in [k for k in os.environ if k.startswith("PALLAS_AXON_")]:
     os.environ.pop(_k, None)
 
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
 
@@ -105,3 +106,31 @@ def pytest_collection_modifyitems(config, items):
                    "(XLA:CPU); probed via a 2-rank dist_sync allreduce")
         for item in dist_marked:
             item.add_marker(skip)
+
+
+@pytest.fixture(autouse=True)
+def _sanitize_marked(request):
+    """Run `sanitize`-marked tests under the runtime lock-order sanitizer
+    (mxnet_tpu.analysis.sanitizer): threading.Lock/RLock are swapped for
+    instrumented wrappers for the duration of the test, and any ABBA
+    cycle observed in the process-wide lock-order graph fails the test
+    with both acquisition stacks. Opt out with MXNET_SANITIZER=0 (the
+    tier-1 default is ON for marked suites)."""
+    if request.node.get_closest_marker("sanitize") is None \
+            or os.environ.get("MXNET_SANITIZER", "1") == "0":
+        yield
+        return
+
+    from mxnet_tpu.analysis import sanitizer
+
+    sanitizer.install()
+    sanitizer.reset()
+    try:
+        yield
+    finally:
+        rep = sanitizer.report()
+        sanitizer.uninstall()
+        sanitizer.reset()
+    if rep["cycles"]:
+        pytest.fail("runtime sanitizer observed lock-order cycle(s):\n"
+                    + sanitizer.format_report(rep), pytrace=False)

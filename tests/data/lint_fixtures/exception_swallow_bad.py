@@ -1,0 +1,29 @@
+"""Fixture: silent catch-alls inside worker loops — every handler here
+must be flagged by the exception-swallow checker."""
+
+import time
+
+
+def decode_worker(pool):
+    while not pool.stopped:
+        try:
+            pool.step()
+        except Exception:
+            pass  # crash becomes a silent hang
+
+
+def supervision_loop(replicas):
+    while True:
+        for rep in replicas:
+            try:
+                rep.health_check()
+            except:  # noqa: E722 — the bare form is the point
+                continue
+
+
+def retry_forever(chan):
+    while True:
+        try:
+            return chan.recv()
+        except BaseException:
+            time.sleep(0.01)  # backoff alone is still a swallow

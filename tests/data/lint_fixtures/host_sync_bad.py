@@ -1,4 +1,6 @@
-"""Seeded host-sync violation: a declared hot path that syncs per batch."""
+"""Seeded host-sync violations: a declared hot path that syncs per
+batch, and a sync reachable only through TWO call hops from the root —
+the reachability engine must walk the chain and print it."""
 
 
 # graftlint: hotpath
@@ -7,3 +9,17 @@ def serve_batch(batcher, batch):
     host = out.asnumpy()          # BAD: d2h sync on the request path
     out.wait_to_read()            # BAD: execution fence per batch
     return host
+
+
+# graftlint: hotpath
+def pump(iterator, sink):
+    while iterator.more():
+        step(iterator, sink)
+
+
+def step(iterator, sink):
+    sink.push(fetch_metrics(iterator))
+
+
+def fetch_metrics(it):
+    return it.metric.asnumpy()    # BAD: two call hops below the hot root

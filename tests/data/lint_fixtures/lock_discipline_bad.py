@@ -1,5 +1,7 @@
-"""Seeded lock-discipline violations: ABBA cycle, mixed guarded/unguarded
-mutation, blocking work + future resolution under the run lock."""
+"""Seeded lock-discipline violations: same-class ABBA cycle, mixed
+guarded/unguarded mutation, blocking work + future resolution under the
+run lock, a CROSS-CLASS ABBA whose two halves only meet through call
+edges, and blocking Event.wait hidden one call below the lock."""
 import threading
 
 
@@ -39,3 +41,48 @@ class AsyncWriter:
             with open(path, "wb") as f:     # BAD: I/O under hand-off lock
                 f.write(snap)               # BAD: I/O under hand-off lock
             self._pending = snap
+
+
+# --- cross-class ABBA: neither class alone shows a cycle; the lock sets
+# --- only collide once they propagate through the two call edges
+
+class Journal:
+    def __init__(self):
+        self._log_lock = threading.Lock()
+
+    def commit(self, sink, item):
+        with self._log_lock:        # C held...
+            sink.record_stat(item)  # ...then D acquired inside the callee
+
+    def log_locked(self):
+        with self._log_lock:
+            pass
+
+
+class StatSink:
+    def __init__(self):
+        self._stat_lock = threading.Lock()
+
+    def record_stat(self, item):
+        with self._stat_lock:
+            pass
+
+    def snapshot(self, journal):
+        with self._stat_lock:       # D held...
+            journal.log_locked()    # ...then C: cycle spans both classes
+
+
+# --- blocking wait one call below the lock
+
+class Gate:
+    def __init__(self):
+        self._g_lock = threading.Lock()
+        self._ready = threading.Event()
+
+    def _wait_ready(self):
+        self._ready.wait()
+
+    def sync_in(self):
+        with self._g_lock:
+            self._wait_ready()      # BAD: Event.wait while the lock is
+            return True             # held — hidden a call down

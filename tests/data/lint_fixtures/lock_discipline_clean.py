@@ -1,5 +1,6 @@
-"""Clean counterpart: one global acquisition order, every shared-field
-write guarded, the run lock held only around the swap itself."""
+"""Clean counterpart: one global acquisition order (also across the two
+classes and through call edges), every shared-field write guarded, the
+run lock held only around the swap itself, waits happen after release."""
 import threading
 
 
@@ -33,3 +34,49 @@ class Pool:
 
     def _swap(self):
         pass
+
+
+# --- the two classes keep ONE order through call edges: journal before
+# --- sink, in both directions of the collaboration
+
+class Journal:
+    def __init__(self):
+        self._log_lock = threading.Lock()
+
+    def commit(self, sink, item):
+        with self._log_lock:
+            sink.record_stat(item)
+
+    def log_locked(self):
+        with self._log_lock:
+            pass
+
+
+class StatSink:
+    def __init__(self):
+        self._stat_lock = threading.Lock()
+
+    def record_stat(self, item):
+        with self._stat_lock:
+            pass
+
+    def snapshot(self, journal):
+        journal.log_locked()        # take C OUTSIDE D, then D alone
+        with self._stat_lock:
+            pass
+
+
+# --- wait first, lock second
+
+class Gate:
+    def __init__(self):
+        self._g_lock = threading.Lock()
+        self._ready = threading.Event()
+
+    def _wait_ready(self):
+        self._ready.wait()
+
+    def sync_in(self):
+        self._wait_ready()          # wait with nothing held
+        with self._g_lock:
+            return True

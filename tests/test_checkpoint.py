@@ -16,6 +16,10 @@ from mxnet_tpu import checkpoint as ckpt
 from mxnet_tpu import faultinject as fi
 from mxnet_tpu import telemetry as tm
 
+# the async writer thread hands checkpoints off under a condition: run
+# the suite under the runtime lock-order sanitizer in tier-1
+pytestmark = pytest.mark.sanitize
+
 _ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 

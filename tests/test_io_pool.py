@@ -28,6 +28,10 @@ from mxnet_tpu import telemetry as tm  # noqa: E402
 from mxnet_tpu.base import MXNetError  # noqa: E402
 from mxnet_tpu.io_plane import DecodePool, input_split  # noqa: E402
 
+# the decode plane is the most thread-dense subsystem in the tree: run
+# the whole suite under the runtime lock-order sanitizer in tier-1
+pytestmark = pytest.mark.sanitize
+
 cv2 = pytest.importorskip("cv2")
 
 

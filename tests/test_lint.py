@@ -14,7 +14,7 @@ import sys
 import pytest
 
 from mxnet_tpu import analysis
-from mxnet_tpu.analysis.checkers.host_sync import HOT_PATHS
+from mxnet_tpu.analysis.checkers.host_sync import ROOTS
 
 pytestmark = pytest.mark.lint
 
@@ -29,6 +29,7 @@ _FIXTURE_NAME = {  # checker name -> fixture stem
     "env-registry": "env_registry",
     "telemetry-catalog": "telemetry_catalog",
     "lock-discipline": "lock_discipline",
+    "exception-swallow": "exception_swallow",
     "typos": "typos",
 }
 
@@ -70,20 +71,22 @@ def test_baseline_entries_still_hit():
     )
 
 
-def test_hot_path_table_matches_tree():
-    """Every declared hot-path qualname must resolve to a real function —
-    otherwise a rename silently removes the invariant from coverage."""
+def test_hot_roots_table_matches_tree():
+    """Every declared hot ROOT qualname must resolve to a real function —
+    otherwise a rename silently removes an entire hot plane from
+    reachability coverage (the failure mode that killed the old
+    HOT_PATHS table, except N functions at a time)."""
     from mxnet_tpu.analysis.core import iter_defs
 
-    for rel, quals in HOT_PATHS.items():
+    for rel, quals in ROOTS.items():
         full = os.path.join(ROOT, rel)
         with open(full, encoding="utf-8") as f:
             tree = ast.parse(f.read(), filename=rel)
         present = {q for q, _cls, _fn in iter_defs(tree)}
         missing = set(quals) - present
         assert not missing, (
-            f"{rel}: declared hot paths not found: {sorted(missing)} "
-            "(renamed? update HOT_PATHS in analysis/checkers/host_sync.py)"
+            f"{rel}: declared hot roots not found: {sorted(missing)} "
+            "(renamed? update ROOTS in analysis/checkers/host_sync.py)"
         )
 
 
@@ -127,6 +130,58 @@ def test_lock_discipline_catches_each_rule():
     for needle in ("cycle", "written", "run lock", "hand-off lock"):
         assert needle in messages, (
             f"expected a {needle!r} finding in: {messages}")
+
+
+def test_lock_discipline_is_interprocedural():
+    """The acceptance pins of the call-graph upgrade: an ABBA cycle whose
+    two halves live in different classes and only meet through call
+    edges, and a blocking wait hidden one call below the lock."""
+    bad = _fixture("lock_discipline", "bad")
+    result = _lint([bad], checks=["lock-discipline"])
+    messages = " | ".join(f.message for f in result.findings)
+    # cross-class cycle: both lock ids named, from different classes
+    assert "Journal._log_lock" in messages
+    assert "StatSink._stat_lock" in messages
+    cycle_msgs = [f.message for f in result.findings
+                  if "cycle" in f.message]
+    assert any("Journal._log_lock" in m and "StatSink._stat_lock" in m
+               for m in cycle_msgs), cycle_msgs
+    # blocking Event.wait reported at the call site, naming the callee
+    assert "inside" in messages and "_wait_ready" in messages
+
+
+def test_host_sync_reports_two_hop_chain():
+    """A sync two call hops below a hot root is found, and the finding's
+    message carries the root→function chain."""
+    bad = _fixture("host_sync", "bad")
+    result = _lint([bad], checks=["host-sync"])
+    two_hop = [f for f in result.findings if f.context == "fetch_metrics"]
+    assert two_hop, [f.render() for f in result.findings]
+    msg = two_hop[0].message
+    assert "reachable from hot root" in msg
+    assert "`pump`" not in msg  # chains are fully qualified…
+    assert "pump" in msg and "step" in msg and "->" in msg
+
+
+def test_io_plane_is_in_scope():
+    """io_plane.py must be covered by BOTH interprocedural checkers —
+    the workers/events/watchdogs that shipped unanalyzed under the PR-8
+    scope tables are the motivating case for tree-wide analysis."""
+    from mxnet_tpu.analysis.checkers.lock_discipline import (
+        LockDisciplineChecker)
+
+    assert "mxnet_tpu/io_plane.py" in ROOTS
+    ctx = analysis.build_context(
+        ROOT, files=[os.path.join(ROOT, "mxnet_tpu", "io_plane.py")])
+    probe = LockDisciplineChecker()
+    probe.classes, probe.attr_owner = {}, {}
+    probe.mod_prims, probe.kinds = {}, {}
+    for unit in ctx.units:
+        if unit.tree is not None:
+            probe._discover(unit)
+    prims = {info.prim_id(a) for info in probe.classes.values()
+             for a in info.prims}
+    assert "DecodePool._cv" in prims, prims
 
 
 # --------------------------------------------------------------------------
@@ -240,15 +295,48 @@ def test_cli_tree_is_green():
         f"python tools/lint.py failed:\n{proc.stdout}\n{proc.stderr}")
 
 
+def test_cli_only_flag_restricts_checkers():
+    """`--only=` is the triage spelling of `--checks`: the bad lock
+    fixture fires under its own checker and goes green when the run is
+    restricted to an unrelated one."""
+    bad = _fixture("lock_discipline", "bad")
+    proc = _run_cli([bad, "--only=lock-discipline", "--format=json",
+                     "--no-baseline"])
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["findings"] and all(
+        f["check"] == "lock-discipline" for f in report["findings"])
+
+    proc = _run_cli([bad, "--only=exception-swallow", "--format=json",
+                     "--no-baseline"])
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["findings"] == []
+
+
+def test_cli_callgraph_mode():
+    """`--callgraph QUALNAME` prints the node's callers/callees plus the
+    graph totals; an unknown name exits 2."""
+    proc = _run_cli(["--callgraph", "DecodePool.next_result"])
+    assert proc.returncode == 0, proc.stderr
+    for needle in ("DecodePool.next_result", "callees", "callers",
+                   "graph:", "functions"):
+        assert needle in proc.stdout, proc.stdout
+
+    proc = _run_cli(["--callgraph", "NoSuchFunctionAnywhere"])
+    assert proc.returncode == 2, proc.stdout
+
+
 def test_cli_does_not_import_the_framework():
     """Linting must work without jax: the CLI loads the self-contained
     analysis package, never mxnet_tpu itself (a broken venv must still
-    be able to lint)."""
+    be able to lint). The call-graph engine and the runtime sanitizer
+    ride the same standalone load path."""
+    lint_py = os.path.join(ROOT, "tools", "lint.py")
     probe = (
         "import sys, runpy\n"
         "sys.argv = ['lint.py', '--list']\n"
         "runpy.run_path(r'%s', run_name='__main__')\n"
-    ) % os.path.join(ROOT, "tools", "lint.py")
+    ) % lint_py
     proc = subprocess.run(
         [sys.executable, "-c",
          "import sys\n"
@@ -256,3 +344,38 @@ def test_cli_does_not_import_the_framework():
          + probe],
         capture_output=True, text=True, cwd=ROOT, timeout=120)
     assert "host-sync" in proc.stdout, proc.stderr
+
+    # the whole-program call graph builds with jax absent too
+    probe = (
+        "import sys, runpy\n"
+        "sys.argv = ['lint.py', '--callgraph', 'DecodePool.next_result']\n"
+        "runpy.run_path(r'%s', run_name='__main__')\n"
+    ) % lint_py
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\nsys.modules['jax'] = None\n" + probe],
+        capture_output=True, text=True, cwd=ROOT, timeout=120)
+    assert "callees" in proc.stdout, proc.stderr
+
+    # the sanitizer arms standalone: lock factories patch, a guarded
+    # acquire/release round-trips, and the report comes back clean
+    san = os.path.join(ROOT, "mxnet_tpu", "analysis", "sanitizer.py")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys, importlib.util\n"
+         "sys.modules['jax'] = None\n"
+         "spec = importlib.util.spec_from_file_location("
+         "'sanitizer', r'%s')\n"
+         "san = importlib.util.module_from_spec(spec)\n"
+         "spec.loader.exec_module(san)\n"
+         "san.install()\n"
+         "import threading\n"
+         "with threading.Lock():\n"
+         "    pass\n"
+         "rep = san.report()\n"
+         "san.uninstall()\n"
+         "assert rep['cycles'] == [], rep\n"
+         "print('sanitizer-standalone-ok')\n" % san],
+        capture_output=True, text=True, cwd=ROOT, timeout=120)
+    assert "sanitizer-standalone-ok" in proc.stdout, (
+        proc.stdout + proc.stderr)

@@ -29,6 +29,10 @@ from mxnet_tpu.serving import (DeadlineExceeded, LatencyHistogram,
                                ModelServer, ServerClosed, ServerOverloaded,
                                ServingConfig)
 
+# batcher/replica-pool/server threads: tier-1 runs this suite under the
+# runtime lock-order sanitizer (opt out with MXNET_SANITIZER=0)
+pytestmark = pytest.mark.sanitize
+
 
 def _mlp_params(seed=0, num_classes=4, scale=1.0):
     sym = models.mlp(num_classes=num_classes)

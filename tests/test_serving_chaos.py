@@ -37,7 +37,7 @@ from mxnet_tpu.serving import (DynamicBatcher, ModelServer,
                                NoHealthyReplicas, ServerOverloaded,
                                ServingConfig, WorkerCrashed)
 
-pytestmark = pytest.mark.chaos
+pytestmark = [pytest.mark.chaos, pytest.mark.sanitize]
 
 
 @pytest.fixture(autouse=True)

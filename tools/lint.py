@@ -5,6 +5,9 @@ Usage:
     python tools/lint.py                     # lint the tree, text report
     python tools/lint.py --format=json       # machine-readable report
     python tools/lint.py --check host-sync   # one checker only
+    python tools/lint.py --only=host-sync,lock-discipline  # a subset
+    python tools/lint.py --callgraph DecodePool.next_result  # debug:
+                                             # resolved callees/callers
     python tools/lint.py --write-baseline    # grandfather current findings
     python tools/lint.py path/to/file.py ... # lint specific files
 
@@ -50,6 +53,15 @@ def main(argv=None):
     p.add_argument("--check", action="append", dest="checks",
                    metavar="NAME", help="run only this checker "
                    "(repeatable); see --list")
+    p.add_argument("--only", metavar="NAME[,NAME...]",
+                   help="run only these checkers (comma-separated "
+                   "spelling of --check, for fast iteration)")
+    p.add_argument("--callgraph", metavar="QUALNAME",
+                   help="debug mode: print the resolved callees/callers/"
+                   "unresolved calls for every function whose qualified "
+                   "name matches (suffix match, e.g. "
+                   "'DecodePool.next_result'), plus graph-wide stats; "
+                   "no linting happens")
     p.add_argument("--list", action="store_true",
                    help="list checkers and exit")
     p.add_argument("--write-baseline", action="store_true",
@@ -66,10 +78,33 @@ def main(argv=None):
             print(f"{c.name:20s} {c.doc}")
         return 0
 
+    if args.only:
+        args.checks = (args.checks or []) + [
+            c.strip() for c in args.only.split(",") if c.strip()]
     known = set(analysis.checker_names())
     for c in args.checks or ():
         if c not in known:
             p.error(f"unknown checker {c!r} (have: {sorted(known)})")
+
+    if args.callgraph:
+        ctx = analysis.build_context(
+            args.root,
+            [os.path.abspath(f) for f in args.paths] if args.paths
+            else None)
+        graph = ctx.callgraph()
+        hits = graph.find(args.callgraph)
+        if not hits:
+            print(f"no function matches {args.callgraph!r}",
+                  file=sys.stderr)
+            return 2
+        for node_id in hits:
+            print(graph.describe(node_id))
+            print()
+        s = graph.stats()
+        print(f"graph: {s['functions']} functions, "
+              f"{s['edges']} resolved call edges, "
+              f"{s['unresolved_calls']} unresolved calls")
+        return 0
 
     files = None
     if args.paths:
