@@ -306,6 +306,41 @@ def _maybe_mfu(record, samples_per_sec, jax, on_tpu, dtype, flops_per_sample,
             samples_per_sec * flops_per_sample / (peak * 1e12), 3)
 
 
+def _stamp_device_recipe(record, mx, models, on_tpu, dtype):
+    """Stamp the resolved conv-stack device layout (MXNET_CONV_LAYOUT,
+    ops/layout.py) and the precision recipe on a headline record, so a
+    rate move in the trajectory is attributable to the device-side config
+    that caused it."""
+    record["layout"] = models.recipe.conv_layout(
+        mx.gpu() if on_tpu else mx.cpu())
+    record["recipe"] = models.recipe.recipe_name(dtype)
+
+
+def _kernel_attribution(mx, mod, batch, k=2):
+    """Top-10 per-kernel device-time table for one steady-state train
+    window of ``mod``: traced AFTER the timed region (attribution never
+    pollutes the measurement) with the jax device profiler and aggregated
+    by telemetry.kernel_table. Returns [] when the profiler is
+    unavailable; BENCH_KERNELS=0 skips the extra window entirely. The
+    caller's timed loop just ran the same (shapes, K) program, so the
+    traced window executes warm — no compile lands in the timeline."""
+    if os.environ.get("BENCH_KERNELS", "1") == "0":
+        return []
+    import tempfile
+
+    td = tempfile.mkdtemp(prefix="bench_kernels_")
+    try:
+        mx.profiler.profiler_set_config(
+            filename=os.path.join(td, "kernels.json"))
+        mx.profiler.profiler_set_state("run")
+        mod.train_window(batch, k, publish_grads=False).wait()
+        trace = mx.profiler.dump_profile()
+        return mx.telemetry.kernel_table(trace) if trace else []
+    except Exception as e:
+        print(f"kernel attribution skipped: {e}", file=sys.stderr)
+        return []
+
+
 def _resnet_train_flops(models, num_layers, image, batch_size):
     """Train FLOPs/img for the train/fit headline records (3x forward; at
     50 layers @224 this reproduces the 12.3 GFLOP/img the MFU field has
@@ -345,6 +380,45 @@ def _sweep_fit(mx, models, batch_size, image, dtype, num_layers, on_tpu,
     os.environ["MXNET_DISPATCH_DEPTH"] = str(best[2])
     print(f"sweep winner: K={best[1]} depth={best[2]} "
           f"({best[0]:.1f} img/s)", file=sys.stderr)
+    return results
+
+
+def _sweep_xla(mx, models, batch_size, image, dtype, num_layers, on_tpu,
+               iters):
+    """BENCH_SWEEP=xla: sweep MXNET_XLA_FLAGS candidates with short fit
+    runs, adopt the fastest in the environment for the headline
+    measurement, and return per-candidate rates so the trajectory records
+    the choice. Candidates come from BENCH_SWEEP_XLA as ;-separated flag
+    strings (each a comma-separated MXNET_XLA_FLAGS value; the empty
+    string = compiler defaults). The flags feed both executable digests
+    and the AOT fingerprint, so every candidate really recompiles — a
+    candidate XLA rejects is recorded as an error, not a crash."""
+    cands = os.environ.get(
+        "BENCH_SWEEP_XLA",
+        ";xla_latency_hiding_scheduler=true" if on_tpu
+        else ";xla_cpu_enable_fast_math=true"
+        ";xla_llvm_disable_expensive_passes=true").split(";")
+    results = []
+    best = None
+    for flags in cands:
+        os.environ["MXNET_XLA_FLAGS"] = flags
+        mod = _build_module(mx, models, batch_size, image, dtype,
+                            num_layers, on_tpu)
+        mx.telemetry.reset()
+        entry = {"xla_flags": flags}
+        try:
+            rate, _spread, _cold = _run_fit_mode(
+                mx, mod, batch_size, image, dtype, iters, 1)
+            entry["img_per_sec"] = round(rate, 2)
+            if best is None or rate > best[0]:
+                best = (rate, flags)
+        except Exception as e:
+            entry["error"] = f"{type(e).__name__}: {e}"[:200]
+        results.append(entry)
+    os.environ["MXNET_XLA_FLAGS"] = best[1] if best else ""
+    print(f"xla sweep winner: {best[1] or '<defaults>'} "
+          f"({best[0]:.1f} img/s)" if best else "xla sweep: no candidate ran",
+          file=sys.stderr)
     return results
 
 
@@ -876,10 +950,13 @@ def _train_leg(mx, mod, batch, k, depth, windows, warmup, samples_per_step):
 
 
 def _suite_classifier(mx, models, jax, on_tpu, sym, data_shape, num_classes,
-                      dtype, cfg, init=None, optimizer_params=None):
+                      dtype, cfg, init=None, optimizer_params=None,
+                      kernels=False):
     """Shared train+infer legs for the single-input classifier-shaped
     workloads (MLP, LeNet, ResNet, SSD-train rides the same path with its
-    own label plumbing — see _suite_ssd)."""
+    own label plumbing — see _suite_ssd). ``kernels=True`` appends the
+    top-10 per-kernel device-time table (one extra traced window after
+    the timed legs)."""
     k, depth, windows, warmup, infer_iters = cfg
     bs = data_shape[0]
     ctx = mx.gpu() if on_tpu else mx.cpu()
@@ -903,8 +980,11 @@ def _suite_classifier(mx, models, jax, on_tpu, sym, data_shape, num_classes,
     imod.init_params(initializer=init or mx.init.Xavier())
     infer_rate, _ = _forward_rate(mx, imod, batch, infer_iters, warmup)
     fwd = _fwd_flops(models, sym, data=data_shape)
-    return _workload_record(jax, on_tpu, train_rate, infer_rate, dtype, k,
-                            depth, steady, fwd, finite=finite)
+    rec = _workload_record(jax, on_tpu, train_rate, infer_rate, dtype, k,
+                           depth, steady, fwd, finite=finite)
+    if kernels:
+        rec["kernels"] = _kernel_attribution(mx, mod, batch, k)
+    return rec
 
 
 def _suite_mlp(mx, models, jax, on_tpu, dtype, cfg):
@@ -929,7 +1009,7 @@ def _suite_resnet50(mx, models, jax, on_tpu, dtype, cfg):
     return _suite_classifier(
         mx, models, jax, on_tpu, sym, (bs,) + image, 1000, dtype, cfg,
         init=mx.init.Xavier(rnd_type="gaussian", factor_type="in",
-                            magnitude=2))
+                            magnitude=2), kernels=True)
 
 
 def _suite_ssd(mx, models, jax, on_tpu, dtype, cfg):
@@ -1183,6 +1263,7 @@ def _run_suite_mode(mx, models, jax, on_tpu):
         "workloads": workloads,
     }
     _maybe_mesh(record, mx)
+    _stamp_device_recipe(record, mx, models, on_tpu, dtype)
     print(json.dumps(record))
 
 
@@ -1342,6 +1423,9 @@ def main():
         if os.environ.get("BENCH_SWEEP") == "1":
             sweep = _sweep_fit(mx, models, batch_size, image, dtype,
                                num_layers, on_tpu, max(iters, 2))
+        elif os.environ.get("BENCH_SWEEP") == "xla":
+            sweep = _sweep_xla(mx, models, batch_size, image, dtype,
+                               num_layers, on_tpu, max(iters, 2))
 
     mod = _build_module(mx, models, batch_size, image, dtype, num_layers,
                         on_tpu)
@@ -1378,12 +1462,17 @@ def main():
         _maybe_mfu(record, img_per_sec, jax, on_tpu, dtype,
                    _resnet_train_flops(models, num_layers, image, batch_size))
         _maybe_mesh(record, mx)
+        _stamp_device_recipe(record, mx, models, on_tpu, dtype)
         window_k = mx.telemetry.gauge("fit.train_window_k").value
         if window_k:
             record["train_window_k"] = window_k
         _fit_phase_fields(record, snapshot)
         if sweep is not None:
             record["sweep"] = sweep
+            if os.environ.get("BENCH_SWEEP") == "xla":
+                # the adopted winner (what the headline number ran under)
+                record["best_xla_flags"] = os.environ.get(
+                    "MXNET_XLA_FLAGS", "")
         if tracing:
             device_trace = mx.profiler.dump_profile()  # stops the trace
             merged = mx.telemetry.merge_chrome_trace(
@@ -1392,8 +1481,20 @@ def main():
                 os.environ.get("BENCH_TELEMETRY_OUT", "bench_telemetry.json"))
             record["trace"] = merged
             record["telemetry_snapshot"] = snap_path
+            # attribute per-kernel device time straight off the merged
+            # timeline the run already paid for
+            record["kernels"] = mx.telemetry.kernel_table(merged)
             print(f"merged trace: {merged}  snapshot: {snap_path} "
                   f"{prom_path}", file=sys.stderr)
+        if "kernels" not in record or not record["kernels"]:
+            rng = np.random.RandomState(3)
+            abatch = mx.io.DataBatch(
+                data=[mx.nd.array(rng.uniform(-1, 1, (batch_size,) + image)
+                                  .astype(np.float32), dtype=dtype)],
+                label=[mx.nd.array(rng.randint(0, 1000, (batch_size,))
+                                   .astype(np.float32))])
+            record["kernels"] = _kernel_attribution(
+                mx, mod, abatch, int(record.get("train_window_k") or 2))
         # AFTER the trace dump: the fresh module's recompile must not
         # pollute the steady-state timeline the trace documents
         if os.environ.get("BENCH_WARM_START", "1") != "0":

@@ -118,6 +118,10 @@ def _fingerprint():
         _source_digest(), jax.default_backend(), len(devs),
         getattr(devs[0], "device_kind", ""),
         str(_env.get("MXNET_MESH") or ""),
+        # compiler/layout knobs: flags or conv layout change the emitted
+        # program wholesale, so cached executables must never cross them
+        str(_env.get("MXNET_XLA_FLAGS") or ""),
+        str(_env.get("MXNET_CONV_LAYOUT") or "auto"),
     )
 
 

@@ -453,6 +453,28 @@ _declare("MXNET_XLA_TPU_OPTIONS", str, "",
          "e.g. 'xla_tpu_scoped_vmem_limit_kib=65536' trades fusion VMEM "
          "budget against pipelining (helps some matmul-heavy programs, "
          "hurts ResNet-style conv nets; benchmark before setting).")
+_declare("MXNET_XLA_FLAGS", str, "",
+         "Comma-separated key=value XLA compiler options attached to every "
+         "executor program on EVERY backend (unlike MXNET_XLA_TPU_OPTIONS, "
+         "which is TPU-only; when both are set the TPU options win on "
+         "conflicting keys). Values parse as bool/int/float when they look "
+         "like one, else stay strings — e.g. "
+         "'xla_latency_hiding_scheduler=true,xla_llvm_disable_expensive_"
+         "passes=false'. Feeds the AOT env fingerprint and both executable "
+         "digests, so persisted AOT caches never serve a program compiled "
+         "under different flags. Sweep candidates with BENCH_SWEEP=xla "
+         "before adopting a winner (docs/benchmarks.md, Device-side "
+         "tuning).")
+_declare("MXNET_CONV_LAYOUT", str, "auto",
+         "Device layout for the 2-D conv stack: 'NCHW' keeps the "
+         "reference layout end to end; 'NHWC' lowers Convolution/Pooling/"
+         "BatchNorm channels-last (the TPU-native layout — channels ride "
+         "the 128-wide lanes) with layout conversions only at graph edges "
+         "— the logical graph, shapes, weights and checkpoints stay NCHW, "
+         "so the two modes are bitwise-interchangeable on integer "
+         "lattices; 'auto' (default) picks NHWC on TPU and NCHW "
+         "elsewhere. Part of the compile cache key and the AOT env "
+         "fingerprint.")
 
 
 def get(name):

@@ -128,24 +128,98 @@ def _is_tpu_ctx(ctx):
         return False
 
 
-def _tpu_compiler_options(ctx):
-    """XLA compiler options for this executor's programs (TPU targets only).
+def _parse_xla_flag(v):
+    """Coerce an MXNET_XLA_FLAGS value string to bool/int/float when it
+    looks like one (XLA's debug-option overrides are typed)."""
+    low = v.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            pass
+    return v
 
-    The TPU stand-in for the reference's per-device kernel tuning knobs
-    (cuDNN autotune registry / Convolution ``workspace``): a catalogued env
-    var (``MXNET_XLA_TPU_OPTIONS``) carries key=value options to the TPU
-    compiler; CPU-targeted executors get none.
+
+def _compiler_options(ctx):
+    """XLA compiler options for this executor's programs.
+
+    The stand-in for the reference's per-device kernel tuning knobs (cuDNN
+    autotune registry / Convolution ``workspace``), carried by two
+    catalogued env vars: ``MXNET_XLA_FLAGS`` applies on every backend
+    (values coerced to bool/int/float when they look like one — XLA's
+    debug-option overrides are typed), and ``MXNET_XLA_TPU_OPTIONS`` is
+    layered on top for TPU targets only, winning on conflicting keys.
+    Both feed the AOT digests and the cache env fingerprint, so a
+    persisted executable never serves a program compiled under different
+    flags. ``BENCH_SWEEP=xla`` (bench.py) sweeps candidate flag sets
+    before a winner is adopted.
     """
-    if not _is_tpu_ctx(ctx):
-        return None
     from . import env
 
     opts = {}
-    for item in env.get("MXNET_XLA_TPU_OPTIONS").split(","):
+    for item in env.get("MXNET_XLA_FLAGS").split(","):
         k, _, v = item.strip().partition("=")
         if k:
-            opts[k] = v.strip()
+            opts[k] = _parse_xla_flag(v.strip())
+    if _is_tpu_ctx(ctx):
+        for item in env.get("MXNET_XLA_TPU_OPTIONS").split(","):
+            k, _, v = item.strip().partition("=")
+            if k:
+                opts[k] = v.strip()
     return opts or None
+
+
+# Most recent fused-window lowering/executable, kept as live objects and
+# rendered to text on demand (tools/hlo_audit.py): holding the Lowered and
+# the executable costs nothing beyond the jit cache already keeping them.
+_FUSED_HLO = {}
+_FUSED_DONATE = (0, 1, 3, 4, 8, 9, 10, 11)
+
+
+def _record_fused_hlo(lowered, exe, call_args):
+    """Stash the fused train-update program for the donation/upcast audit."""
+    try:
+        import jax
+
+        donated, pos = [], 0
+        param_shapes = []
+        for i, a in enumerate(call_args):
+            leaves = jax.tree_util.tree_leaves(a)
+            if i in _FUSED_DONATE:
+                donated.extend(range(pos, pos + len(leaves)))
+            if i == 0:  # updated parameters
+                param_shapes = [tuple(v.shape) for v in leaves]
+            pos += len(leaves)
+        _FUSED_HLO.update(
+            lowered=lowered, compiled=exe, donated_args=donated,
+            n_args=pos, param_shapes=param_shapes,
+        )
+    except Exception:  # noqa: BLE001 — observability must not break training
+        pass
+
+
+def fused_window_hlo():
+    """HLO record of the most recent fused train-window compile, or None.
+
+    Returns a dict with ``lowered`` (StableHLO MLIR text — donated args
+    carry ``tf.aliasing_output`` when jax matched them to an output),
+    ``compiled`` (post-optimization HLO text — the ``input_output_alias``
+    header is the executable's aliasing table), ``donated_args`` (flat
+    indices the executor donated), ``n_args`` and ``param_shapes`` (shapes
+    of the updated parameters). ``tools/hlo_audit.py`` consumes this to
+    fail on un-aliased donations and stray parameter-sized f32 upcasts.
+    """
+    if not _FUSED_HLO:
+        return None
+    rec = dict(_FUSED_HLO)
+    try:
+        rec["lowered"] = rec["lowered"].as_text()
+        rec["compiled"] = rec["compiled"].as_text()
+    except Exception:  # noqa: BLE001 — renderers differ across jax versions
+        return None
+    return rec
 
 
 class _CompiledGraph:
@@ -157,13 +231,17 @@ class _CompiledGraph:
     nodes inserted by the PlaceDevice pass (graph_executor.cc:286-385).
     """
 
-    def __init__(self, symbol, node2dev=None, remat=False):
+    def __init__(self, symbol, node2dev=None, remat=False, layout="NCHW"):
         self.symbol = symbol
         self.node2dev = node2dev or {}
         # remat (reference MXNET_BACKWARD_DO_MIRROR): wrap each op in
         # jax.checkpoint so backward recomputes op-internal values from op
         # inputs instead of storing them — FLOPs for activation memory
         self.remat = remat
+        # device layout for the conv stack (ops/layout.py): "NHWC" re-lowers
+        # Convolution/Pooling/BatchNorm channels-last at interpretation time
+        # while the logical graph, shapes and weights stay NCHW
+        self.layout = layout
         self.topo = symbol._topo()
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -192,16 +270,23 @@ class _CompiledGraph:
         monitoring (op outputs already cover all interior edges)."""
         import jax
 
+        from .ops import layout as _lay
+
+        nhwc = self.layout == "NHWC"
         env = {}
+        cl = {}  # id(node) -> per-output channels-last flags (NHWC mode)
         aux_updates = list(aux_vals)
         executed = 0
         last_outs = []
+        last_cl = []
         for node in self.topo:
             if node.is_variable:
                 if node.is_aux:
                     env[id(node)] = [aux_vals[self._aux_index[node.name]]]
                 else:
                     env[id(node)] = [arg_vals[self._arg_index[node.name]]]
+                if nhwc:
+                    cl[id(node)] = [False]
                 if monitor is not None and monitor_all:
                     monitor(node.name, env[id(node)][0])
                 continue
@@ -209,6 +294,32 @@ class _CompiledGraph:
                 break
             params = node.params()
             ins = [env[id(inode)][idx] for (inode, idx) in node.inputs]
+            node_layout = None
+            if nhwc:
+                # channels-last plane (ops/layout.py): aware ops lower NHWC
+                # (activation transposed in at the first one), followers pass
+                # channels-last values through, everything else is a graph
+                # edge that gets its operands transposed back to NCHW
+                in_cl = [cl[id(inode)][idx] for (inode, idx) in node.inputs]
+                name = node.op.name
+                if _lay.aware(name, params, getattr(ins[0], "ndim", 0)):
+                    node_layout = "NHWC"
+                    if not in_cl[0]:
+                        ins[0] = _lay.to_cl(ins[0])
+                    for j in range(1, len(ins)):  # params stay logical
+                        if in_cl[j]:
+                            ins[j] = _lay.from_cl(ins[j])
+                elif any(in_cl):
+                    if _lay.follower(name, params) and all(
+                        f or getattr(x, "ndim", 0) == 0
+                        for f, x in zip(in_cl, ins)
+                    ):
+                        node_layout = "pass"
+                    else:
+                        ins = [
+                            _lay.from_cl(x) if f else x
+                            for f, x in zip(in_cl, ins)
+                        ]
             dev = self.node2dev.get(id(node))
             if dev is not None:
                 # cross-device edge: move operands onto this node's device
@@ -219,18 +330,29 @@ class _CompiledGraph:
             node_rng = None
             if node.op.need_rng:
                 node_rng = jax.random.fold_in(rng, self._rng_serial[id(node)])
+            op_layout = "NHWC" if node_layout == "NHWC" else None
             if self.remat and not node.op.aux_names(params):
                 apply_fn = jax.checkpoint(
                     lambda inner, _op=node.op, _p=params, _m=OpMode(
-                        is_train=is_train, rng=node_rng
+                        is_train=is_train, rng=node_rng, layout=op_layout
                     ): _op.apply(inner, _p, _m)
                 )
                 outs, new_aux = apply_fn(ins)
             else:
                 outs, new_aux = node.op.apply(
-                    ins, params, OpMode(is_train=is_train, rng=node_rng)
+                    ins, params,
+                    OpMode(is_train=is_train, rng=node_rng, layout=op_layout),
                 )
             env[id(node)] = outs
+            if nhwc:
+                if node_layout == "NHWC":
+                    # 4-D outputs are channels-last; BN's mean/var are (C,)
+                    cl[id(node)] = [getattr(o, "ndim", 0) == 4 for o in outs]
+                elif node_layout == "pass":
+                    cl[id(node)] = [True] * len(outs)
+                else:
+                    cl[id(node)] = [False] * len(outs)
+                last_cl = cl[id(node)]
             last_outs = outs
             executed += 1
             if new_aux:
@@ -240,11 +362,23 @@ class _CompiledGraph:
                     aux_updates[self._aux_index[aux_node.name]] = na
             if monitor is not None:
                 for i, o in enumerate(outs[: node.op.num_visible_outputs(params)]):
+                    if nhwc and cl[id(node)][i]:
+                        o = _lay.from_cl(o)  # monitors see logical layout
                     suffix = "_output" if i == 0 else f"_output{i}"
                     monitor(node.name + suffix, o)
         if limit is not None:
+            if nhwc and last_cl:
+                last_outs = [
+                    _lay.from_cl(o) if f else o
+                    for o, f in zip(last_outs, last_cl)
+                ]
             return last_outs, aux_updates
         head_outs = [env[id(node)][idx] for (node, idx) in self.heads]
+        if nhwc:
+            head_outs = [
+                _lay.from_cl(o) if cl[id(node)][idx] else o
+                for o, (node, idx) in zip(head_outs, self.heads)
+            ]
         return head_outs, aux_updates
 
 
@@ -262,9 +396,12 @@ class Executor:
         # NaiveEngine: synchronous un-jitted execution for debugging
         # (reference sync-debug engine toggle, src/engine/engine.cc:14-27)
         self._naive = _env.get("MXNET_ENGINE_TYPE") == "NaiveEngine"
+        from .ops import layout as _lay
+
         self.graph = _CompiledGraph(
             symbol, node2dev=self._node2dev,
             remat=_env.get("MXNET_BACKWARD_DO_MIRROR"),
+            layout=_lay.resolve(self._ctx),
         )
         self.arg_names = self.graph.arg_names
         self.aux_names = self.graph.aux_names
@@ -662,6 +799,7 @@ class Executor:
                 tuple(sorted((n, r) for n, r in self.grad_req.items())),
                 self._pack_fill(self.arg_names, arg_pack),
                 self._pack_fill(self.aux_names, aux_pack),
+                self.graph.layout,
             )
             self._sig_cache = sig
         return sig
@@ -719,12 +857,13 @@ class Executor:
         shard_tok = self._shardings_token()
         if shard_tok is None:
             return None
-        opts = _tpu_compiler_options(self._ctx)
+        opts = _compiler_options(self._ctx)
         dev = self._ctx.jax_device()
         return _aot.digest(
             "jit", self._sym_sha(), cache_key[:-1],
             self._mesh_token(cache_key[-1]), shard_tok, self.graph.remat,
-            dev.platform, getattr(dev, "device_kind", ""),
+            self.graph.layout, dev.platform,
+            getattr(dev, "device_kind", ""),
             tuple(sorted(opts.items())) if opts else (),
         )
 
@@ -745,15 +884,15 @@ class Executor:
             return None
         (update_names, cache_token, with_hg, state_td, has_handles,
          sched_mesh, n_steps, stack_names, guard_on, publish) = plan_key
-        opts = _tpu_compiler_options(self._ctx)
+        opts = _compiler_options(self._ctx)
         dev = self._ctx.jax_device()
         return _aot.digest(
             "fused", self._sym_sha(), self._jit_signature(),
             (update_names, cache_token, with_hg, repr(state_td),
              has_handles, n_steps, stack_names, guard_on, publish),
             self._mesh_token(sched_mesh), shard_tok,
-            auto_layout, self.graph.remat, dev.platform,
-            getattr(dev, "device_kind", ""),
+            auto_layout, self.graph.remat, self.graph.layout,
+            dev.platform, getattr(dev, "device_kind", ""),
             tuple(sorted(opts.items())) if opts else (),
         )
 
@@ -882,7 +1021,7 @@ class Executor:
         else:
             fn = _aot.AOTProgram(
                 jax.jit(traced,
-                        compiler_options=_tpu_compiler_options(self._ctx)),
+                        compiler_options=_compiler_options(self._ctx)),
                 key_digest=self._aot_digest(cache_key),
                 # a real XLA compile in steady state is a perf bug worth
                 # surfacing; deserialized warm starts don't count
@@ -1646,14 +1785,14 @@ class Executor:
                         pass  # layout API unavailable: default layouts
                 jit_fn = jax.jit(
                     _step_k, donate_argnums=(0, 1, 3, 4, 8, 9, 10, 11),
-                    compiler_options=_tpu_compiler_options(self._ctx),
+                    compiler_options=_compiler_options(self._ctx),
                     **jit_kw,
                 )
             else:
                 plan_auto = False
                 jit_fn = jax.jit(
                     _step, donate_argnums=(0, 1, 3, 4, 8, 9, 10, 11),
-                    compiler_options=_tpu_compiler_options(self._ctx),
+                    compiler_options=_compiler_options(self._ctx),
                 )
             plan = (
                 jit_fn,
@@ -1757,10 +1896,12 @@ class Executor:
                                     v.shape, v.dtype),
                                 call_args,
                             )
-                            aot[0] = fn.lower(*lower_args).compile()
+                            lowered = fn.lower(*lower_args)
+                            aot[0] = lowered.compile()
                             aot[1] = jax.tree_util.tree_leaves(
                                 aot[0].input_formats
                             )
+                            _record_fused_hlo(lowered, aot[0], call_args)
                         except Exception:
                             # without the executable+formats pair the
                             # boundary conversions can't run — recompile
@@ -1770,13 +1911,17 @@ class Executor:
                             plain = jax.jit(
                                 fn.__wrapped__,
                                 donate_argnums=(0, 1, 3, 4, 8, 9, 10, 11),
-                                compiler_options=_tpu_compiler_options(
+                                compiler_options=_compiler_options(
                                     self._ctx
                                 ),
                             )
-                            aot[0] = plain.lower(*call_args).compile()
+                            lowered = plain.lower(*call_args)
+                            aot[0] = lowered.compile()
+                            _record_fused_hlo(lowered, aot[0], call_args)
                     else:
-                        aot[0] = fn.lower(*call_args).compile()
+                        lowered = fn.lower(*call_args)
+                        aot[0] = lowered.compile()
+                        _record_fused_hlo(lowered, aot[0], call_args)
                     _aot.store(pdigest, aot[0])
                 if aot[1] is not None:
                     # donated steady-state buffers already carry the

@@ -1,21 +1,74 @@
-"""Shared low-precision training recipe and the analytic FLOPs estimator.
+"""Shared precision/layout recipes and the analytic FLOPs estimator.
 
 Reference: the explicit fp16 symbol variants
 (``example/image-classification/symbols/resnet_fp16.py`` /
 ``alexnet_fp16.py``) cast the input to fp16 right after the data variable
 and cast back to fp32 before the classifier so the softmax/loss runs in
-full precision. The TPU recipe is identical with bfloat16: the conv trunk
-runs bf16 on the MXU, master weights stay f32 (the executor's master-dtype
-rule), and the head computes in f32.
+full precision. The TPU recipes generalize that:
+
+- ``f32`` — everything float32 (the parity oracle).
+- ``bf16_master`` — bf16 everywhere with f32 master weights: the symbol
+  casts activations into the bf16 trunk (:func:`low_precision_io`), the
+  executor's master-dtype rule keeps parameters and optimizer state f32
+  and casts each parameter at its point of use, and the fused train-update
+  epilogue applies the f32 update in the same program — no extra
+  parameter-sized writes appear (``tools/hlo_audit.py`` verifies the
+  lowered window program: every donated buffer aliased, no stray f32
+  upcasts of parameter-sized bf16 values). ``bf16`` is an alias: with the
+  master-dtype rule always on, plain bf16 *is* the master-weight recipe.
+- ``int8_serving`` — post-training weight quantization for the serving
+  path (:func:`int8_weights`): per-tensor symmetric fake-quant of the
+  matrix/conv weights, applied by ``ModelServer(variant="int8")`` after
+  BN folding; activations stay f32/bf16.
+
+:func:`conv_layout` reports the device layout the executor will lower the
+conv stack in (``MXNET_CONV_LAYOUT``, ops/layout.py) so benches and tools
+can stamp records without re-deriving the resolution rule.
 
 ``estimate_flops`` is the per-symbol analytic model that lets bench report
 MFU for every workload (conv/deconv/dense/rnn counted from the serialized
-graph + inferred shapes) instead of hardcoding ResNet-50@224.
+graph + inferred shapes) instead of hardcoding ResNet-50@224. Grouped and
+depthwise Convolution count ``in_ch/num_group`` MACs per output — computed
+from the node attrs, not the weight-shape lookup, so ResNeXt-style MFU is
+not overstated even when the weight input is an already-shaped composite.
 """
 
 import json
 
+import numpy as np
+
 from .. import symbol as sym
+from ..base import parse_shape
+
+# name -> (compute/activation dtype, parameter master dtype)
+RECIPES = {
+    "f32": {"compute_dtype": "float32", "master_dtype": "float32"},
+    "bf16": {"compute_dtype": "bfloat16", "master_dtype": "float32"},
+    "bf16_master": {"compute_dtype": "bfloat16", "master_dtype": "float32"},
+    "int8_serving": {"compute_dtype": "float32", "master_dtype": "float32",
+                     "weight_dtype": "int8"},
+}
+
+
+def get(name):
+    """The named recipe dict (KeyError lists the catalogue)."""
+    try:
+        return dict(RECIPES[name])
+    except KeyError:
+        raise KeyError(
+            f"unknown recipe {name!r} (have: {sorted(RECIPES)})") from None
+
+
+def recipe_name(dtype):
+    """Canonical recipe name for a trunk dtype string (bench stamping)."""
+    return "bf16_master" if str(dtype) == "bfloat16" else "f32"
+
+
+def conv_layout(ctx=None):
+    """The resolved conv-stack device layout for ``ctx`` ("NCHW"/"NHWC")."""
+    from ..ops import layout as _lay
+
+    return _lay.resolve(ctx)
 
 
 def low_precision_io(x, dtype, out=False):
@@ -24,6 +77,45 @@ def low_precision_io(x, dtype, out=False):
     if dtype in (None, "float32"):
         return x
     return sym.Cast(x, dtype="float32" if out else dtype)
+
+
+def quantize_int8(arr):
+    """Per-tensor symmetric int8 quantization: ``(q, scale)`` with
+    ``q = round(arr / scale)`` clipped to [-127, 127] and
+    ``scale = max|arr| / 127`` (scale 1.0 for an all-zero tensor)."""
+    a = np.asarray(arr, dtype=np.float32)
+    amax = float(np.max(np.abs(a))) if a.size else 0.0
+    scale = amax / 127.0 if amax > 0.0 else 1.0
+    q = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def dequantize_int8(q, scale):
+    """Inverse of :func:`quantize_int8` (float32)."""
+    return q.astype(np.float32) * np.float32(scale)
+
+
+def int8_weights(arg_params, min_size=1024):
+    """Post-training int8 weight quantization (fake-quant) for serving.
+
+    Every float parameter with ndim >= 2 and at least ``min_size`` elements
+    (the conv/dense weights — biases and folded-BN vectors stay exact) is
+    replaced by its quantize-dequantize image, so the graph and kernels are
+    unchanged while the weights carry exactly the int8 information content.
+    Returns ``(new_params, report)`` where the report maps each quantized
+    name to its scale — the serving stats surface.
+    """
+    out, report = {}, {}
+    for name, arr in arg_params.items():
+        a = np.asarray(arr)
+        if (a.ndim >= 2 and a.size >= min_size
+                and np.issubdtype(a.dtype, np.floating)):
+            q, scale = quantize_int8(a)
+            out[name] = dequantize_int8(q, scale).astype(a.dtype)
+            report[name] = scale
+        else:
+            out[name] = arr
+    return out, report
 
 
 def _prod(xs):
@@ -98,9 +190,9 @@ def estimate_flops(symbol, batch=None, **shape_kwargs):
             total += 1.0 * seq_len * macs
             continue
         w = arg_shape.get(nodes[node["inputs"][1][0]]["name"])
-        if not w:
-            continue
         if op == "FullyConnected":
+            if not w:
+                continue
             # MACs = rows × num_hidden × in_dim; rows may exceed batch when
             # the graph folds time into the leading axis (seq-major heads)
             in_shape = _node_shape(shape_dict, nodes, node["inputs"][0])
@@ -108,11 +200,29 @@ def estimate_flops(symbol, batch=None, **shape_kwargs):
             total += 1.0 * (rows / batch) * _prod(w)
         elif op == "Convolution":
             out = _node_shape(shape_dict, nodes, (node_id, 0))
+            in_shape = _node_shape(shape_dict, nodes, node["inputs"][0])
             if not out:
                 continue
-            # per output position × per filter: in_ch/g × kh × kw MACs
-            total += 1.0 * _prod(out[2:]) * _prod(w)
+            # per output position × per filter: in_ch/num_group × kh × kw
+            # MACs — from the node attrs + input shape, so grouped/depthwise
+            # convs (ResNeXt, MobileNet-style) and convs whose weight input
+            # is not a plain null arg are both counted correctly (the old
+            # weight-shape lookup silently skipped the latter)
+            kernel = parse_shape(attrs.get("kernel", "()"))
+            groups = int(attrs.get("num_group", 1))
+            if in_shape and kernel:
+                macs_per_pos = (
+                    int(attrs["num_filter"]) * (int(in_shape[1]) // groups)
+                    * _prod(kernel)
+                )
+            elif w:
+                macs_per_pos = _prod(w)  # weight is (nf, in_ch/g, *k)
+            else:
+                continue
+            total += 1.0 * _prod(out[2:]) * macs_per_pos
         else:  # Deconvolution: each input pixel scatters a full kernel
+            if not w:
+                continue
             in_shape = _node_shape(shape_dict, nodes, node["inputs"][0])
             if not in_shape:
                 continue

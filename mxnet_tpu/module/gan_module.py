@@ -308,12 +308,12 @@ class GANModule:
             last = tuple(o[-1] for o in outs)
             return (g_args, g_aux, d_args, d_aux, g_sts, d_sts, last)
 
-        from ..executor import _tpu_compiler_options
+        from ..executor import _compiler_options
 
         jit_fn = jax.jit(
             step_fn, donate_argnums=(0, 1, 2, 3, 4, 5),
             static_argnames=(),
-            compiler_options=_tpu_compiler_options(g_exe._ctx),
+            compiler_options=_compiler_options(g_exe._ctx),
         )
         return {"fn": jit_fn, "g_host": g_host, "d_host": d_host,
                 "g_names": g_names, "d_names": d_names,

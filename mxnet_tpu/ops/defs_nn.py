@@ -158,6 +158,23 @@ def _conv(ins, params, mode):
     stride = params["stride"] or (1,) * nsp
     dilate = params["dilate"] or (1,) * nsp
     pad = params["pad"] or (0,) * nsp
+    if mode.layout == "NHWC" and nsp == 2 and data.ndim == 4:
+        # channels-last lowering (ops/layout.py): the activation arrives
+        # (N, H, W, C); the weight stays logical OIHW — permuting it here
+        # keeps its gradient and every checkpoint in reference layout.
+        out = jax.lax.conv_general_dilated(
+            data,
+            weight.transpose(2, 3, 1, 0),  # OIHW -> HWIO
+            window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=params["num_group"],
+            precision=_prec(data.dtype),
+        )
+        if bias is not None:
+            out = out + bias  # broadcasts over the minor-most channel axis
+        return out
     if (
         nsp == 2 and stride == (2, 2) and dilate == (1, 1)
         and params["num_group"] == 1 and data.shape[1] <= 4
@@ -366,8 +383,14 @@ def _batch_norm(ins, params, mode):
     momentum = params["momentum"]
     if params["fix_gamma"]:
         gamma = jnp.ones_like(gamma)  # constant → zero gradient, as reference
-    axes = tuple(i for i in range(data.ndim) if i != 1)
-    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    if mode.layout == "NHWC" and data.ndim == 4:
+        # channels-last lowering (ops/layout.py): reduce over N/H/W, channel
+        # params broadcast on the minor-most axis
+        axes = (0, 1, 2)
+        bshape = (1, 1, 1, -1)
+    else:
+        axes = tuple(i for i in range(data.ndim) if i != 1)
+        bshape = (1, -1) + (1,) * (data.ndim - 2)
     use_global = params["use_global_stats"] or not mode.is_train
     if use_global:
         mean, var = moving_mean, moving_var
@@ -540,8 +563,12 @@ register(
 def _pooling(ins, params, mode):
     (x,) = ins
     nsp = x.ndim - 2
+    # channels-last lowering (ops/layout.py): spatial axes start at 1 and
+    # the channel axis is minor-most
+    cl = mode.layout == "NHWC" and x.ndim == 4
+    sp0 = 1 if cl else 2
     if params["global_pool"]:
-        k = x.shape[2:]
+        k = x.shape[sp0:sp0 + nsp]
         stride = (1,) * nsp
         pad = (0,) * nsp
     else:
@@ -554,14 +581,19 @@ def _pooling(ins, params, mode):
         lo = pad[i]
         hi = pad[i]
         if params["pooling_convention"] == "full" and not params["global_pool"]:
-            size = x.shape[2 + i]
+            size = x.shape[sp0 + i]
             full_out = -(-(size + 2 * pad[i] - k[i]) // stride[i]) + 1
             valid_out = (size + 2 * pad[i] - k[i]) // stride[i] + 1
             hi += (full_out - valid_out) * stride[i]
         pads.append((lo, hi))
-    window = (1, 1) + tuple(k)
-    strides = (1, 1) + tuple(stride)
-    padding = ((0, 0), (0, 0)) + tuple(pads)
+    if cl:
+        window = (1,) + tuple(k) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        padding = ((0, 0),) + tuple(pads) + ((0, 0),)
+    else:
+        window = (1, 1) + tuple(k)
+        strides = (1, 1) + tuple(stride)
+        padding = ((0, 0), (0, 0)) + tuple(pads)
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, padding)

@@ -53,6 +53,10 @@ class OpMode:
 
     is_train: bool = False
     rng: object = None  # jax PRNG key, present iff opdef.need_rng
+    # device layout for the conv stack: "NHWC" means the activation input
+    # arrives channels-last and the op must lower channels-last (set only
+    # for layout-aware ops — see ops/layout.py); None = logical NCHW
+    layout: str = None
 
 
 class Param:

@@ -259,6 +259,12 @@ class ModelServer:
     input_types : dict name -> dtype, optional
         Input dtypes (token-id inputs should be integer — forwarded to
         each bucket ``Predictor``).
+    variant : str, optional
+        Serving weight recipe: ``"f32"`` (default) serves the loaded
+        weights as-is; ``"int8"`` applies post-training per-tensor
+        symmetric weight quantization (models/recipe.py
+        ``int8_weights``) after BN folding — reload re-quantizes, and
+        :meth:`stats` reports the per-tensor scales.
 
     Lifecycle: ``warmup()`` (compile every replica × bucket) → ``start()``
     (accept traffic; implies warmup) → ``submit``/``predict`` →
@@ -268,13 +274,18 @@ class ModelServer:
 
     def __init__(self, symbol, params, input_shapes, config=None, ctx=None,
                  dev_type="cpu", dev_id=0, input_types=None, logger=None,
-                 sym_gen=None):
+                 sym_gen=None, variant=None):
         from ..symbol import Symbol, fromjson, load as sym_load
 
         from ..context import Context
 
         self.config = config or ServingConfig()
         self.logger = logger or logging.getLogger("mxnet_tpu.serving")
+        if variant not in (None, "f32", "int8"):
+            raise MXNetError(f"unknown serving variant {variant!r} "
+                             "(have: 'f32', 'int8')")
+        self.variant = variant or "f32"
+        self._int8_scales = {}
         self._sym_gen = sym_gen
         if sym_gen is not None:
             # BucketingModule-style sequence serving: the symbol varies
@@ -305,6 +316,7 @@ class ModelServer:
         else:
             self._symbol, arg_params, aux_params = self._fold(
                 sym, arg_params, aux_params)
+        arg_params = self._apply_variant(arg_params)
         self._sample_shapes = {k: tuple(v) for k, v in input_shapes.items()}
         self._input_names = tuple(self._sample_shapes)
         self._input_types = dict(input_types or {})
@@ -508,6 +520,32 @@ class ModelServer:
             return sym, arg_params, aux_params
         self._fold_active = True
         return folded_sym, folded_args, aux_params
+
+    def _apply_variant(self, arg_params):
+        """Post-fold weight transform for the serving ``variant``.
+
+        ``"int8"`` runs models.recipe.int8_weights — per-tensor symmetric
+        fake-quant of the conv/dense weight matrices — AFTER BN folding,
+        so the quantization grid is set on the weights the graph actually
+        multiplies by (folding afterwards would rescale the grid away).
+        The per-tensor scales land in :meth:`stats` as the serving-side
+        record of what was quantized. ``"f32"`` is the identity.
+        """
+        if self.variant != "int8":
+            return arg_params
+        from ..models import recipe
+        from ..ndarray import NDArray, array
+
+        host = {k: np.asarray(v._data) if isinstance(v, NDArray)
+                else np.asarray(v) for k, v in arg_params.items()}
+        quant, scales = recipe.int8_weights(host)
+        self._int8_scales = scales
+        out = dict(arg_params)
+        for name in scales:
+            out[name] = array(quant[name])
+        self.logger.info("serving: int8 variant quantized %d weight "
+                         "tensor(s)", len(scales))
+        return out
 
     def _to_ctx(self, params, ctx=None):
         from ..ndarray import NDArray
@@ -738,6 +776,9 @@ class ModelServer:
                 bound = set(self._symbol.list_arguments())
                 arg_params = {k: v for k, v in arg_params.items()
                               if k in bound}
+            # re-quantize the swapped weights under the active variant:
+            # a reload must not silently de-quantize an int8 server
+            arg_params = self._apply_variant(arg_params)
             new_version = self.version + 1
             ok = 0
             for rep in self._pool.replicas:
@@ -881,4 +922,7 @@ class ModelServer:
             "version": self.version,
             "latency": self.latency.snapshot(),
             "inputs": {n: list(s) for n, s in self._sample_shapes.items()},
+            "variant": self.variant,
+            "int8_weights": {n: round(s, 8)
+                             for n, s in sorted(self._int8_scales.items())},
         }

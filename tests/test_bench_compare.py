@@ -135,3 +135,43 @@ def test_lower_better_flag_inverts_direction(tmp_path):
     assert bench_compare.main([base, higher]) == 0
     assert bench_compare.main(
         [base, higher, "--metrics", "value", "--lower-better", "value"]) == 1
+
+
+def test_kernel_table_membership_diff_notes_but_never_gates(tmp_path):
+    """Top-10 kernel tables are diffed by membership (newly-in / left,
+    with the newcomer's share of step time) — informational only: XLA
+    renames fusions across otherwise-identical compiles, so membership
+    churn must never fail the gate."""
+    base = _clone()
+    base["workloads"]["dcgan"]["kernels"] = [
+        {"name": "fusion.1", "device_us": 900.0, "calls": 2, "pct": 0.6},
+        {"name": "convolution.3", "device_us": 600.0, "calls": 2,
+         "pct": 0.4},
+    ]
+    new = json.loads(json.dumps(base))
+    new["workloads"]["dcgan"]["kernels"] = [
+        {"name": "fusion.1", "device_us": 905.0, "calls": 2, "pct": 0.55},
+        {"name": "all-reduce.9", "device_us": 700.0, "calls": 2,
+         "pct": 0.45},
+    ]
+    b, n = _write(tmp_path, "b.json", base), _write(tmp_path, "n.json", new)
+    _, regressions, notes = bench_compare.compare(
+        bench_compare.load_record(b), bench_compare.load_record(n), 5.0)
+    assert not regressions
+    joined = "\n".join(notes)
+    assert "workloads.dcgan.kernels: newly in top-10: all-reduce.9" in joined
+    assert "(45.0% of step)" in joined
+    assert "left top-10: convolution.3" in joined
+    assert bench_compare.main([b, n]) == 0  # membership churn never gates
+
+
+def test_kernel_diff_skips_tables_missing_from_base(tmp_path):
+    """A record growing its first kernel table (older baseline without
+    one) produces no churn notes and no gate."""
+    base = _clone()
+    new = json.loads(json.dumps(base))
+    new["workloads"]["dcgan"]["kernels"] = [
+        {"name": "fusion.1", "device_us": 1.0, "calls": 1, "pct": 1.0}]
+    assert bench_compare.diff_kernels(base, new) == []
+    b, n = _write(tmp_path, "b.json", base), _write(tmp_path, "n.json", new)
+    assert bench_compare.main([b, n]) == 0

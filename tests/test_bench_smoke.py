@@ -5,6 +5,9 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
+
+import pytest
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -60,6 +63,9 @@ def test_bench_fit_mode_reaches_window_rate():
     # the guard-overhead re-measure is test_bench_cpu_smoke's job; here it
     # would only stretch the train-mode run this comparison waits on
     env["BENCH_GUARD"] = "0"
+    # kernel attribution is pinned by the guard-on test; the profiled
+    # window would only stretch this throughput comparison
+    env["BENCH_KERNELS"] = "0"
 
     def run(mode):
         e = dict(env)
@@ -127,6 +133,22 @@ def test_bench_fit_guard_on_keeps_no_sync_invariant():
     assert rec.get("dispatch_depth", 0) >= 2, rec
     assert rec.get("train_window_k", 0) == 2, rec
     assert 0 < rec.get("dispatch_span_share", 0) <= 1, rec
+    # device-side attribution contract (ISSUE 18): every fit record names
+    # its conv layout + precision recipe and embeds the top-10 per-kernel
+    # device-time table (attributed AFTER the timed region)
+    assert rec["layout"] in ("NCHW", "NHWC"), rec
+    assert rec["recipe"] in ("f32", "bf16_master"), rec
+    kernels = rec["kernels"]
+    assert 0 < len(kernels) <= 10, kernels
+    total_pct = 0.0
+    for row in kernels:
+        assert row["name"] and row["device_us"] > 0 and row["calls"] >= 1
+        assert 0 <= row["pct"] <= 1
+        total_pct += row["pct"]
+    assert total_pct <= 1.0 + 1e-6, kernels
+    # sorted by device time, heaviest first
+    assert all(a["device_us"] >= b["device_us"]
+               for a, b in zip(kernels, kernels[1:])), kernels
 
 
 def test_bench_serve_mode_beats_sequential_and_never_compiles():
@@ -316,6 +338,16 @@ def test_bench_suite_whole_zoo_smoke():
         assert w["gflops_per_sample_fwd"] > 0, (name, w)
         assert w["window_k"] >= 2 and w["dispatch_depth"] >= 2, (name, w)
         assert w["dtype"] in ("float32", "bfloat16"), (name, w)
+    # device-side attribution (ISSUE 18): the suite record is stamped
+    # with its layout + recipe, and the flagship resnet-50 leg embeds the
+    # per-kernel device-time top-10 ("where did the step time go")
+    assert rec["layout"] in ("NCHW", "NHWC"), rec
+    assert rec["recipe"] in ("f32", "bf16_master"), rec
+    kernels = rec["workloads"]["resnet-50"]["kernels"]
+    assert 0 < len(kernels) <= 10, kernels
+    for row in kernels:
+        assert row["name"] and row["device_us"] > 0 and row["calls"] >= 1
+        assert 0 <= row["pct"] <= 1
     dcgan = rec["workloads"]["dcgan"]
     assert dcgan["legacy_train_samples_per_sec"] > 0
     speedup = dcgan["fused_speedup"]
@@ -404,7 +436,7 @@ def test_bench_fit_recordio_leg():
     the input plane keeps the chip fed."""
     knobs = dict(BENCH_MODE="fit", BENCH_LAYERS="18", BENCH_BATCH="4",
                  BENCH_ITERS="3", BENCH_WINDOWS="2", BENCH_GUARD="0",
-                 BENCH_WARM_START="0")
+                 BENCH_WARM_START="0", BENCH_KERNELS="0")
     syn = _run_bench(_bench_env(**knobs))
     rec = _run_bench(_bench_env(BENCH_FIT_DATA="recordio", **knobs))
     assert rec["fit_data"] == "recordio"
@@ -418,6 +450,51 @@ def test_bench_fit_recordio_leg():
     assert rate >= 0.7 * syn["value"], (
         f"recordio fit at {rate} img/s vs synthetic {syn['value']} "
         f"img/s — the decode plane starves the training loop")
+
+
+@pytest.mark.slow
+def test_bench_xla_flag_sweep_smoke():
+    """BENCH_SWEEP=xla: the compiler-flag sweep must try every candidate
+    from BENCH_SWEEP_XLA through MXNET_XLA_FLAGS (a rebuilt module per
+    candidate — the flags feed compile options AND the AOT fingerprint),
+    record the per-candidate table, and adopt a winner. slow-marked: a
+    sweep is an extra fit compile per candidate on top of the headline
+    run; the flag-threading itself is unit-pinned in test_executor.py."""
+    rec = _run_bench(_bench_env(
+        BENCH_MODE="fit", BENCH_LAYERS="18", BENCH_BATCH="4",
+        BENCH_ITERS="2", BENCH_WINDOWS="1", BENCH_WARM_START="0",
+        BENCH_KERNELS="0", BENCH_SWEEP="xla",
+        BENCH_SWEEP_XLA="xla_cpu_enable_fast_math=true"))
+    sweep = rec["sweep"]
+    assert sweep and sweep[0]["xla_flags"] == "xla_cpu_enable_fast_math=true"
+    assert sweep[0]["img_per_sec"] > 0, sweep
+    assert "best_xla_flags" in rec, rec
+    assert rec["value"] > 0
+
+
+def test_hlo_audit_fused_window_clean():
+    """tools/hlo_audit.py on the fused resnet-18 window program: every
+    donated buffer must be aliased in the compiled executable (zero
+    un-aliased donations, zero silently dropped marks) and the bf16
+    recipe must show no stray f32 upcasts beyond the per-step gradient
+    promotions the master-weight design requires."""
+    env = _bench_env(MXNET_AOT_CACHE="0")
+    out = os.path.join(tempfile.mkdtemp(prefix="hlo_audit_"), "verdict.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "hlo_audit.py"),
+         "--layers", "18", "--batch", "2", "--window", "2", "--json", out],
+        capture_output=True, text=True, env=env, timeout=900, cwd=_ROOT,
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    with open(out) as f:
+        verdict = json.load(f)
+    assert verdict["ok"] is True, verdict
+    assert verdict["unaliased_donations"] == [], verdict
+    assert verdict["dropped_donations"] == 0, verdict
+    assert verdict["donated_args"] > 0, verdict
+    assert verdict["aliased_args"] + verdict["donor_args"] \
+        == verdict["donated_args"], verdict
+    assert verdict["stray_upcasts"] == {}, verdict
 
 
 def test_graft_entry_single_chip_compiles():

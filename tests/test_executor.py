@@ -191,3 +191,29 @@ def test_nonuniform_workload_warns():
         )
     assert any("workload" in str(x.message) for x in w), \
         [str(x.message) for x in w]
+
+
+def test_xla_flags_reach_compile_options_and_digests(monkeypatch):
+    """MXNET_XLA_FLAGS threads into the per-executable compiler options
+    (typed: bools/ints coerced — XLA's debug-option overrides are typed)
+    AND into the AOT digest/fingerprint, so a persisted executable never
+    serves a program compiled under different flags."""
+    from mxnet_tpu import aot
+    from mxnet_tpu.executor import _compiler_options, _parse_xla_flag
+
+    monkeypatch.delenv("MXNET_XLA_FLAGS", raising=False)
+    assert _compiler_options(mx.cpu()) is None  # empty -> jax defaults
+    base_digest = aot.digest("probe")
+
+    monkeypatch.setenv(
+        "MXNET_XLA_FLAGS",
+        "xla_cpu_enable_fast_math=true, xla_force_host_platform_device_count=2,"
+        "xla_gpu_autotune_level=0.5,xla_dump_to=/tmp/x")
+    opts = _compiler_options(mx.cpu())
+    assert opts == {"xla_cpu_enable_fast_math": True,
+                    "xla_force_host_platform_device_count": 2,
+                    "xla_gpu_autotune_level": 0.5,
+                    "xla_dump_to": "/tmp/x"}
+    assert _parse_xla_flag("false") is False
+    # different flags => different AOT digest for the SAME program
+    assert aot.digest("probe") != base_digest

@@ -657,3 +657,56 @@ def test_batcher_pad_and_bucket_telemetry():
         assert futs[0].bucket == 4
     finally:
         srv.close()
+
+
+def test_int8_variant_parity_and_stats():
+    """ModelServer(variant="int8") serves post-training-quantized weights
+    (models/recipe.py int8_weights, applied after BN folding): outputs
+    stay within the int8 parity tolerance of the f32 server, stats()
+    names the quantized tensors, and reload re-quantizes."""
+    net = models.lenet(num_classes=10)
+    shape = (2, 1, 28, 28)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[mx.io.DataDesc("data", shape)],
+             label_shapes=[mx.io.DataDesc("softmax_label", (shape[0],))])
+    mod.init_params(initializer=mx.init.Xavier())
+    arg, aux = mod.get_params()
+    params = ({f"arg:{k}": v for k, v in arg.items()}
+              | {f"aux:{k}": v for k, v in aux.items()})
+    cfg = ServingConfig(buckets=(2,), replicas=1, max_delay_ms=1.0)
+    x = np.random.RandomState(0).rand(1, 28, 28).astype(np.float32)
+
+    with pytest.raises(MXNetError):
+        ModelServer(net, params, {"data": (1, 28, 28)}, config=cfg,
+                    variant="int4")
+
+    outs, stats = {}, {}
+    for variant in ("f32", "int8"):
+        srv = ModelServer(net, params, {"data": (1, 28, 28)}, config=cfg,
+                          variant=variant)
+        srv.start()
+        try:
+            outs[variant] = np.asarray(srv.predict({"data": x})[0],
+                                       dtype=np.float32)
+            stats[variant] = srv.stats()
+            if variant == "int8":
+                srv.reload(params)  # must re-quantize, not de-quantize
+                after = np.asarray(srv.predict({"data": x})[0],
+                                   dtype=np.float32)
+                np.testing.assert_array_equal(after, outs["int8"])
+        finally:
+            srv.close()
+
+    assert stats["f32"]["variant"] == "f32"
+    assert stats["f32"]["int8_weights"] == {}
+    assert stats["int8"]["variant"] == "int8"
+    # conv1 (500 elems) stays exact under the min_size=1024 floor; the
+    # big conv/dense weights are quantized
+    q = set(stats["int8"]["int8_weights"])
+    assert {"conv2_weight", "fc1_weight", "fc2_weight"} <= q
+    assert "conv1_weight" not in q
+    assert all(s > 0 for s in stats["int8"]["int8_weights"].values())
+    # int8 parity tolerance: per-tensor symmetric 8-bit weights move the
+    # lenet softmax by well under a percent
+    assert not np.array_equal(outs["int8"], outs["f32"])  # really quantized
+    np.testing.assert_allclose(outs["int8"], outs["f32"], atol=0.01)

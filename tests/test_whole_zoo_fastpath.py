@@ -322,6 +322,30 @@ def test_flops_estimator_closed_forms():
     assert g8 == pytest.approx(g, rel=1e-3)
 
 
+def test_flops_estimator_grouped_depthwise():
+    from mxnet_tpu.models import recipe
+
+    # grouped closed form: out_positions x num_filter x (in_ch/g) x kh x kw
+    data = mx.sym.Variable("data")
+    g4 = mx.sym.Convolution(data, num_filter=32, kernel=(3, 3), pad=(1, 1),
+                            num_group=4, no_bias=True, name="g4")
+    assert recipe.estimate_flops(g4, data=(1, 16, 8, 8)) == pytest.approx(
+        8 * 8 * 32 * (16 // 4) * 3 * 3, rel=1e-6)
+
+    # depthwise (num_group == channels): one input channel per filter
+    dw = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                            num_group=16, no_bias=True, name="dw")
+    assert recipe.estimate_flops(dw, data=(1, 16, 8, 8)) == pytest.approx(
+        8 * 8 * 16 * 1 * 3 * 3, rel=1e-6)
+
+    # ResNeXt-50 32x4d @224: the published ~4.23 GFLOPs. An estimator
+    # that ignores num_group overcounts the grouped bottlenecks ~8x
+    rx = models.resnext(num_classes=1000, num_layers=50,
+                        image_shape="3,224,224")
+    g = recipe.estimate_flops(rx, data=(1, 3, 224, 224))
+    assert g == pytest.approx(4.2305e9, rel=0.02), g
+
+
 def test_zoo_registry_covers_published_table():
     assert len(models.SCORE_SYMBOLS) >= 14
     for net in models.SCORE_SYMBOLS:

@@ -29,6 +29,12 @@ error — a silently vanished metric must not pass). Fields present in only
 one record are reported as added/removed but never gate, so a bench
 record can grow new fields without breaking older baselines.
 
+``kernels`` tables (the top-10 per-kernel device-time attribution bench
+embeds in fit/suite records) are diffed by membership: a kernel newly
+entering or leaving a top-10 is reported in the notes with its share of
+device time — the "where did the step time move" pointer — but never
+gates, since XLA renames fusions across otherwise-identical compiles.
+
 A bench file may hold whole driver output; the LAST line that parses as a
 JSON object is the record (bench.py's output contract).
 """
@@ -72,6 +78,43 @@ def walk(obj, prefix=""):
         return
     elif isinstance(obj, (int, float)):
         yield prefix, float(obj)
+
+
+def kernel_tables(record, prefix=""):
+    """Yield (dotted_path, {kernel_name: row}) for every embedded top-10
+    kernel table (``"kernels"`` lists of {name, device_us, pct} rows)."""
+    if not isinstance(record, dict):
+        return
+    for key, val in record.items():
+        path = f"{prefix}.{key}" if prefix else key
+        if key == "kernels" and isinstance(val, list):
+            yield path, {r["name"]: r for r in val
+                         if isinstance(r, dict) and "name" in r}
+        else:
+            yield from kernel_tables(val, path)
+
+
+def diff_kernels(base, new):
+    """Notes naming kernels that newly entered / left each top-10 table
+    present in both records (informational — never gates)."""
+    base_tables = dict(kernel_tables(base))
+    notes = []
+    for path, rows in kernel_tables(new):
+        old = base_tables.get(path)
+        if old is None or not old:
+            continue
+        entered = [n for n in rows if n not in old]
+        left = [n for n in old if n not in rows]
+        if entered:
+            detail = ", ".join(
+                f"{n} ({100.0 * rows[n].get('pct', 0.0):.1f}% of step)"
+                for n in entered[:5])
+            notes.append(f"{path}: newly in top-10: {detail}"
+                         + (" ..." if len(entered) > 5 else ""))
+        if left:
+            notes.append(f"{path}: left top-10: {', '.join(left[:5])}"
+                         + (" ..." if len(left) > 5 else ""))
+    return notes
 
 
 def lookup(record, path):
@@ -128,7 +171,7 @@ def compare(base, new, threshold, metrics=None, lower_better=()):
         rows.append((path, b, n, delta, direction))
         if regressed:
             regressions.append(path)
-    notes = []
+    notes = diff_kernels(base, new)
     only_base = sorted(set(base_paths) - set(new_paths))
     only_new = sorted(set(new_paths) - set(base_paths))
     if only_base:

@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Donation/upcast audit of the fused train-update window program.
+
+The fused window (executor.fused_train_update) donates every steady-state
+buffer — parameters, aux, optimizer state, hyper tape, guard counters — so
+the whole train step updates in place with zero extra parameter-sized
+writes. That contract is easy to silently lose: a dtype change, a dropped
+return, or a new carry added without a matching output turns a donation
+into a copy (jax warns once, nobody reads it) and the step quietly grows
+an HBM round-trip per parameter. Likewise the bf16 master-weight recipe
+(models/recipe.py) promises exactly one bf16→f32 promotion per parameter
+per step — the gradient cast folded into the update epilogue; any further
+parameter-sized f32 upcast means the master-weight rule regressed.
+
+This tool pins both on the *lowered evidence*, not the implementation:
+
+- **Donation audit** — every buffer the executor donated must surface in
+  the ``@main`` signature of the lowered StableHLO as either
+  ``tf.aliasing_output`` (jax matched it to an output at lowering time) or
+  ``jax.buffer_donor`` (left for the compiler to place); donors must then
+  land in the executable's ``input_output_alias`` table. A donated leaf
+  with *neither* marker is a donation jax dropped (shape/dtype mismatch
+  with every output — the silent-copy case), and fails the audit.
+- **Upcast audit** — in the lowered StableHLO (jax-traced casts only; the
+  backend's own compute-precision converts are out of scope),
+  ``bf16→f32 stablehlo.convert`` ops whose shape equals an updated
+  parameter's shape are counted per shape. The master-weight recipe emits
+  exactly one per parameter per window step (the gradient promotion), so
+  more than ``--max-upcasts-per-param`` (default 1) × window × parameters
+  of that shape fails. Activation-shaped f32 math (BatchNorm statistics)
+  is deliberately out of scope.
+
+Run it as a CLI (builds the fused ResNet window on the default backend,
+prints a JSON verdict, exit 1 on failure)::
+
+    python tools/hlo_audit.py [--layers 50] [--image 3,32,32] [--batch 4]
+                              [--dtype bfloat16] [--window 2] [--json out]
+
+or import :func:`audit` / :func:`audit_current` from tests with a record
+from ``mxnet_tpu.executor.fused_window_hlo()``.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+_ARG_SPLIT_RE = re.compile(r"%arg(\d+):")
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{[\d,\s]*\}:\s*\((\d+),\s*\{[\d,\s]*\},\s*(\w+-alias)\)")
+_UPCAST_RE = re.compile(
+    r"stablehlo\.convert[^\n]*\(tensor<([0-9x]+)xbf16>\)"
+    r"\s*->\s*tensor<\1xf32>")
+
+
+def _main_signature(lowered_text):
+    """The argument list of ``func.func public @main(...)`` — inner
+    functions (while bodies etc.) declare their own %argN and must not be
+    scanned."""
+    i = lowered_text.find("@main(")
+    if i < 0:
+        return ""
+    # the signature ends at the "{" that opens the body; attribute dicts
+    # inside the signature never put their closing brace at end-of-token
+    # position " {" followed by a newline, the body opener does
+    j = lowered_text.find("{\n", i)
+    return lowered_text[i:j if j > 0 else len(lowered_text)]
+
+
+def main_donation_marks(lowered_text):
+    """``(aliased, donors)`` arg-index sets of @main: args jax already
+    matched to an output (``tf.aliasing_output``) and args left for the
+    compiler to place (``jax.buffer_donor``).
+
+    Parsed per argument chunk rather than by an attribute-dict regex —
+    attribute values may nest braces (``mhlo.sharding = "{replicated}"``)
+    which defeats any ``\\{[^}]*\\}`` pattern.
+    """
+    parts = _ARG_SPLIT_RE.split(_main_signature(lowered_text))
+    aliased, donors = set(), set()
+    # parts = [prefix, idx, chunk, idx, chunk, ...]
+    for k in range(1, len(parts) - 1, 2):
+        idx, chunk = int(parts[k]), parts[k + 1]
+        if "tf.aliasing_output" in chunk:
+            aliased.add(idx)
+        elif "jax.buffer_donor" in chunk:
+            donors.add(idx)
+    return aliased, donors
+
+
+def compiled_aliased_params(compiled_text):
+    """Parameter indices in the executable's ``input_output_alias`` table.
+
+    The table is brace-nested (``{ {1}: (21, {}, may-alias), ... }``) so
+    its extent is found by brace counting, not a non-greedy regex.
+    """
+    key = "input_output_alias={"
+    i = compiled_text.find(key)
+    if i < 0:
+        return set()
+    start = i + len(key)
+    depth, j = 1, start
+    while j < len(compiled_text) and depth:
+        depth += {"{": 1, "}": -1}.get(compiled_text[j], 0)
+        j += 1
+    table = compiled_text[start:j]
+    return {int(e.group(1)) for e in _ALIAS_ENTRY_RE.finditer(table)}
+
+
+def param_sized_upcasts(lowered_text, param_shapes):
+    """{shape: count} of jax-traced bf16→f32 converts whose dims equal an
+    updated parameter's shape (the gradient-promotion casts)."""
+    want = {"x".join(str(d) for d in s) for s in param_shapes}
+    counts = {}
+    for m in _UPCAST_RE.finditer(lowered_text):
+        dims = m.group(1)
+        if dims in want:
+            counts[dims] = counts.get(dims, 0) + 1
+    return counts
+
+
+def audit(record, max_upcasts_per_param=1, steps=1):
+    """Audit a ``fused_window_hlo()`` record. Returns a verdict dict with
+    ``ok``, per-check results, and the offending counts/shapes.
+
+    ``steps`` is the window length the program was traced for — the
+    master-weight recipe legitimately promotes each gradient once per
+    step, so the upcast allowance scales with it.
+    """
+    donated = len(record["donated_args"])
+    aliased, donors = main_donation_marks(record["lowered"])
+    compiled = compiled_aliased_params(record["compiled"])
+    # donors the compiler never placed in the alias table
+    unaliased = sorted(donors - compiled)
+    # donated leaves that reached @main with neither marker: jax dropped
+    # the donation entirely (no output of matching shape/dtype)
+    dropped = donated - len(aliased) - len(donors)
+
+    shapes = [tuple(s) for s in record["param_shapes"]]
+    per_shape = {}
+    for s in shapes:
+        key = "x".join(str(d) for d in s)
+        per_shape[key] = per_shape.get(key, 0) + 1
+    upcasts = param_sized_upcasts(record["lowered"], shapes)
+    allowance = max_upcasts_per_param * max(1, int(steps))
+    stray = {
+        dims: n for dims, n in upcasts.items()
+        if n > allowance * per_shape.get(dims, 0)
+    }
+
+    return {
+        "ok": not unaliased and dropped <= 0 and not stray,
+        "donated_args": donated,
+        "aliased_args": len(aliased),
+        "donor_args": len(donors),
+        "dropped_donations": max(0, dropped),
+        "unaliased_donations": unaliased,
+        "param_count": len(shapes),
+        "param_sized_upcasts": upcasts,
+        "stray_upcasts": stray,
+        "max_upcasts_per_param": max_upcasts_per_param,
+        "steps": int(steps),
+    }
+
+
+def audit_current(**kw):
+    """Audit the most recent fused-window compile in this process."""
+    from mxnet_tpu.executor import fused_window_hlo
+
+    rec = fused_window_hlo()
+    if rec is None:
+        raise RuntimeError(
+            "no fused window has been compiled in this process "
+            "(run a train_window first, with the AOT disk cache off)")
+    return audit(rec, **kw)
+
+
+def _build_and_run(layers, image, batch, dtype, window):
+    """Compile + run one fused ResNet train window so the executor records
+    its program."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.resnet import get_symbol
+
+    net = get_symbol(num_classes=10, num_layers=layers, image_shape=image,
+                     dtype=dtype)
+    shape = (batch,) + tuple(int(x) for x in image.split(","))
+    mod = mx.mod.Module(net, context=mx.cpu() if mx.context.num_gpus() == 0
+                        else mx.gpu())
+    mod.bind(data_shapes=[mx.io.DataDesc("data", shape)],
+             label_shapes=[mx.io.DataDesc("softmax_label", (batch,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    b = mx.io.DataBatch(
+        data=[mx.nd.array(rng.rand(*shape).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 10, (batch,)).astype(np.float32))])
+    mod.train_window(b, window, publish_grads=False).wait()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="audit donation aliasing + master-weight upcasts of "
+                    "the fused train window")
+    ap.add_argument("--layers", type=int, default=50)
+    ap.add_argument("--image", default="3,32,32")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--window", type=int, default=2)
+    ap.add_argument("--max-upcasts-per-param", type=int, default=1)
+    ap.add_argument("--json", help="also write the verdict to this path")
+    args = ap.parse_args(argv)
+
+    # the audit needs a fresh lowering: a disk-cached executable skips it
+    os.environ["MXNET_AOT_CACHE"] = "0"
+    _build_and_run(args.layers, args.image, args.batch, args.dtype,
+                   args.window)
+    verdict = audit_current(max_upcasts_per_param=args.max_upcasts_per_param,
+                            steps=args.window)
+    verdict["workload"] = (f"resnet-{args.layers}@{args.image} "
+                           f"bs{args.batch} {args.dtype} K={args.window}")
+    out = json.dumps(verdict, indent=2, sort_keys=True)
+    print(out)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(out + "\n")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
