@@ -19,6 +19,11 @@ def _maybe_init_distributed():
     nproc = env.get("MXNET_NUM_PROCS")
     # raw(): rank 0 unset vs rank 0 exported are different cases — only a
     # launcher-exported rank means this process belongs to a multi-host job
+    if (env.get("MXNET_KV_TRANSPORT") or "mesh").lower() == "tcp":
+        # elastic plane: membership is dynamic, but the jax runtime pins
+        # world size at initialize — every process stays a single-host jax
+        # world and the kvstore's TCP transport carries all collectives
+        return
     if coord and nproc > 1 and env.raw("MXNET_PROC_ID") is not None:
         import jax
 

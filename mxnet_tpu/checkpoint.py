@@ -680,6 +680,23 @@ class CheckpointManager:
         self._fence()
         return out
 
+    def save_local_async(self, next_epoch, next_batch, epoch=None,
+                         nbatch=None):
+        """Unfenced writer-rank snapshot for the elastic reshard. The
+        regular :meth:`save` fences all ranks four times — correct for a
+        static membership, a deadlock during a membership transition (a
+        joiner has never aligned with any fence, a corpse never will). On
+        the elastic plane rank 0 holds a full data-parallel replica, so
+        its local snapshot alone is a valid resume point: snapshot on the
+        training thread, commit on the async writer thread, no fences.
+        Returns the directory the commit will land in (writer only)."""
+        if not self._is_writer():
+            return None
+        with _tm.span("checkpoint.snapshot"):
+            snap = self._snapshot(next_epoch, next_batch, epoch, nbatch)
+        self._writer().submit(snap)
+        return os.path.join(self.config.dir, snap["name"])
+
     def _write_local(self, snap):
         """Single-process commit: phase 1 and phase 2 back to back."""
         root = self.config.dir

@@ -227,6 +227,65 @@ _declare("MXNET_KV_TIMEOUT", float, 0.0,
          "cannot re-admit single ranks. 0 (default) = wait forever; "
          "tools/launch.py exports 600 for supervised jobs unless already "
          "set.")
+_declare("MXNET_KV_TRANSPORT", str, "mesh",
+         "Collective transport under the dist kvstore: 'mesh' (default) = "
+         "in-process XLA leaders over ICI/DCN, static membership; 'tcp' = "
+         "the elastic host-side plane (kvstore_elastic.py) with live "
+         "membership epochs — workers may die, lag and join mid-job. "
+         "'tcp' also skips jax.distributed.initialize (the jax runtime "
+         "pins world size). See docs/distributed.md.")
+_declare("MXNET_KV_HEARTBEAT_MS", float, 1000.0,
+         "Elastic transport: interval between client heartbeats to the "
+         "coordinator (its own socket, so a straggling push never blocks "
+         "liveness).")
+_declare("MXNET_KV_PEER_TIMEOUT", float, 10.0,
+         "Elastic transport: seconds of heartbeat silence after which the "
+         "coordinator declares a worker dead, bumps the membership epoch "
+         "and completes pending rounds over the survivors — the "
+         "MXNET_KV_TIMEOUT watchdog generalized to per-peer liveness.")
+_declare("MXNET_KV_RECONNECT", float, 60.0,
+         "Elastic transport: total seconds a client retries a broken "
+         "coordinator connection (exponential backoff + jitter) before "
+         "raising the typed PeerUnreachable instead of hanging. Also "
+         "bounds dist_async's server reconnects.")
+_declare("MXNET_KV_MAX_STALENESS", int, 0,
+         "Elastic transport bounded staleness (SSP): a pull at clock c is "
+         "served once round c-S closed, letting fast workers run at most "
+         "S rounds ahead of a straggler. 0 = fully synchronous "
+         "(dist_sync semantics).")
+_declare("MXNET_KV_BACKUP_WORKERS", int, 0,
+         "Elastic transport backup-worker mode: close each gradient round "
+         "after all-but-N members contributed, dropping the N slowest "
+         "contributions (rescaled so the mean gradient stays unbiased; "
+         "kvstore.drop_slowest counts). 0 = wait for everyone.")
+_declare("MXNET_KV_COMPRESS", str, "",
+         "Elastic transport gradient compression on the network leg: "
+         "'bf16' or 'int8' (per-tensor max-abs scale), both with "
+         "client-side error feedback — the quantization residual is added "
+         "to the next push. Master weights and pulls stay f32. Empty = "
+         "off.")
+_declare("MXNET_FI_KV_KILL_RANK", int, -1,
+         "Fault injection (elastic kvstore): rank to kill at train batch "
+         "MXNET_FI_KV_KILL_AT_BATCH (-1 = off). The killed worker sends "
+         "no LEAVE — death is discovered by heartbeat silence.")
+_declare("MXNET_FI_KV_KILL_AT_BATCH", int, -1,
+         "Fault injection (elastic kvstore): per-process train-batch "
+         "ordinal at which MXNET_FI_KV_KILL_RANK dies (-1 = off).")
+_declare("MXNET_FI_KV_DELAY_MS", float, 0.0,
+         "Fault injection (elastic kvstore): sleep this long before every "
+         "gradient push on MXNET_FI_KV_DELAY_RANK — a straggler, not a "
+         "death (it keeps heartbeating). 0 = off.")
+_declare("MXNET_FI_KV_DELAY_RANK", int, -1,
+         "Fault injection (elastic kvstore): rank MXNET_FI_KV_DELAY_MS "
+         "applies to; -1 = every rank.")
+_declare("MXNET_FI_KV_DROP_EVERY", int, 0,
+         "Fault injection (elastic kvstore): silently drop every Nth "
+         "client frame before sending (lost packet; the hardened RPC "
+         "layer must retry). 0 = off.")
+_declare("MXNET_FI_KV_CORRUPT_EVERY", int, 0,
+         "Fault injection (elastic kvstore): flip a byte in every Nth "
+         "client frame — the server must detect (crc32/HMAC) and reject "
+         "it (kvstore.corrupt_frame_rejected), never absorb it. 0 = off.")
 _declare("MXNET_FI_CRASH_AT_BATCH", int, -1,
          "Fault injection: os._exit when the process-global train-batch "
          "ordinal reaches this value (-1 = off). All MXNET_FI_* hooks "

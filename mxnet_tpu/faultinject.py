@@ -54,6 +54,35 @@ gate like the serving faults, same attempt/rank scoping):
                                     fuel for ``MXNET_IO_WORKER_TIMEOUT_MS``.
 ==================================  =========================================
 
+Elastic-kvstore faults (chaos harness for the ``MXNET_KV_TRANSPORT=tcp``
+plane in ``kvstore_elastic.py``; separate gate, same attempt scoping —
+kill/delay carry their OWN rank selector since the point is faulting one
+member of a live group):
+
+==================================  =========================================
+``MXNET_FI_KV_KILL_RANK``           with ``MXNET_FI_KV_KILL_AT_BATCH``:
+                                    ``os._exit`` on the worker whose
+                                    ``MXNET_PROC_ID`` equals this rank when
+                                    ITS train-batch ordinal reaches the
+                                    value (a mid-epoch machine death; the
+                                    membership sweeper must declare it and
+                                    survivors reshard to dp−1).
+``MXNET_FI_KV_DELAY_MS``            sleep this long before every gradient
+                                    push on the rank named by
+                                    ``MXNET_FI_KV_DELAY_RANK`` (-1 = all) —
+                                    straggler fuel for bounded staleness
+                                    and backup-worker drop-slowest.
+``MXNET_FI_KV_DROP_EVERY``          silently drop every Nth client frame
+                                    before it is sent (a lost packet — the
+                                    hardened RPC layer must retry, not
+                                    hang).
+``MXNET_FI_KV_CORRUPT_EVERY``       flip a byte in every Nth client frame
+                                    on the wire — the server must DETECT
+                                    it (crc32/HMAC), reject the frame with
+                                    a counter, and the clean resend must
+                                    succeed. Never absorbed.
+==================================  =========================================
+
 Serving-path faults (the chaos harness for ``mxnet_tpu/serving``; same
 ``MXNET_FI_ATTEMPT``/``MXNET_FI_RANK`` gating, read per call so a test —
 or ``bench.py BENCH_CHAOS=1`` — can kill and revive a replica at runtime
@@ -98,6 +127,8 @@ _lock = threading.Lock()
 _batch_ordinal = -1  # process-global count of train batches seen by fit
 _serve_ordinal = 0   # process-global count of serving batch attempts
 _io_fired = set()    # (kind, ordinal) decode-pool injections already fired
+_kv_batch = -1       # train-batch ordinal for the kv kill schedule
+_kv_frame = 0        # process-global count of elastic kvstore frames sent
 
 
 def _csv_ints(name):
@@ -139,11 +170,96 @@ def active():
 
 def reset():
     """Rewind the process-global batch ordinals (tests only)."""
-    global _batch_ordinal, _serve_ordinal
+    global _batch_ordinal, _serve_ordinal, _kv_batch, _kv_frame
     with _lock:
         _batch_ordinal = -1
         _serve_ordinal = 0
         _io_fired.clear()
+        _kv_batch = -1
+        _kv_frame = 0
+
+
+def kv_active():
+    """True when any elastic-kvstore fault is configured for THIS launcher
+    attempt (separate from :func:`active` — kv chaos must not flip fit's
+    window-fusion opt-out; rank scoping is per-fault, not global)."""
+    if not any(_env.raw(k) for k in (
+            "MXNET_FI_KV_KILL_AT_BATCH", "MXNET_FI_KV_DELAY_MS",
+            "MXNET_FI_KV_DROP_EVERY", "MXNET_FI_KV_CORRUPT_EVERY")):
+        return False
+    return _attempt_matches()
+
+
+def _kv_on_train_batch():
+    """The kv kill schedule: a worker death mid-epoch, exercised from
+    ``Module.fit``'s per-batch hook. Own ordinal (``active()``'s counter
+    only advances when the classic fault family is on)."""
+    global _kv_batch
+    if not kv_active():
+        return
+    kill_at = _env.get("MXNET_FI_KV_KILL_AT_BATCH")
+    if kill_at < 0:
+        return
+    with _lock:
+        _kv_batch += 1
+        ordinal = _kv_batch
+    if _env.get("MXNET_PROC_ID") == _env.get("MXNET_FI_KV_KILL_RANK") \
+            and ordinal == kill_at:
+        # a machine death mid-round: no LEAVE, no atexit — the membership
+        # sweeper has to find out the hard way (heartbeat silence)
+        print(f"faultinject: KV-KILL rank {_env.get('MXNET_PROC_ID')} at "
+              f"train batch {ordinal}", flush=True)
+        os._exit(_env.get("MXNET_FI_EXIT_CODE"))
+
+
+def kv_delay():
+    """Straggler injection: called before every elastic gradient push;
+    sleeps ``MXNET_FI_KV_DELAY_MS`` on the configured rank. The delayed
+    worker keeps heartbeating — it is SLOW, not dead, which is exactly the
+    case bounded staleness / drop-slowest must absorb without a reshard."""
+    if not kv_active():
+        return
+    ms = _env.get("MXNET_FI_KV_DELAY_MS")
+    if ms <= 0:
+        return
+    who = _env.get("MXNET_FI_KV_DELAY_RANK")
+    if who >= 0 and who != _env.get("MXNET_PROC_ID"):
+        return
+    _tm.counter("faultinject.kv_delay").inc()
+    import time
+
+    time.sleep(ms / 1e3)
+
+
+def kv_frame_fault():
+    """Per-frame wire fault: returns ``"drop"``, ``"corrupt"`` or None for
+    the frame about to be sent (process-global frame ordinal). A retry
+    resends on a fresh ordinal, so chaos at every-Nth never livelocks."""
+    if not kv_active():
+        return None
+    drop = _env.get("MXNET_FI_KV_DROP_EVERY")
+    corrupt = _env.get("MXNET_FI_KV_CORRUPT_EVERY")
+    if drop <= 0 and corrupt <= 0:
+        return None
+    global _kv_frame
+    with _lock:
+        _kv_frame += 1
+        ordinal = _kv_frame
+    if drop > 0 and ordinal % drop == 0:
+        _tm.counter("faultinject.kv_drop").inc()
+        return "drop"
+    if corrupt > 0 and ordinal % corrupt == 0:
+        _tm.counter("faultinject.kv_corrupt").inc()
+        return "corrupt"
+    return None
+
+
+def kv_corrupt_bytes(frame):
+    """Flip one mid-frame byte — damage the server MUST detect via the
+    crc32/HMAC trailer and reject, never absorb into the model."""
+    buf = bytearray(frame)
+    buf[len(buf) // 2] ^= 0xFF
+    return bytes(buf)
 
 
 def on_train_batch(data_batch):
@@ -151,6 +267,7 @@ def on_train_batch(data_batch):
     and fires any crash/NaN injection scheduled for it. Returns the
     (possibly corrupted) batch."""
     global _batch_ordinal
+    _kv_on_train_batch()
     if not active():
         return data_batch
     with _lock:

@@ -307,89 +307,56 @@ class KVStore:
 
 
 class DistKVStore(KVStore):
-    """Multi-host store over the jax distributed runtime.
+    """Multi-host store over a pluggable :class:`CollectiveTransport`.
 
     Every host runs the same SPMD program; this class supplies the
-    rank/size/barrier coordination the ps-lite scheduler provided. The data
-    path (gradient reduction) rides XLA collectives inside the jitted step —
-    see mxnet_tpu.parallel.
+    rank/size/barrier coordination the ps-lite scheduler provided. HOW the
+    cross-host reduction moves is the transport's business
+    (kvstore_transport.py): the default ``MeshTransport`` rides one XLA
+    collective over the ``process_leader_mesh`` leaders; ``create()``
+    routes ``MXNET_KV_TRANSPORT=tcp`` jobs to the elastic TCP store
+    (kvstore_elastic.py) before this class is ever constructed.
     """
 
-    def __init__(self, kv_type):
+    def __init__(self, kv_type, transport=None):
         super().__init__(kv_type)
-        import jax
+        if transport is None:
+            import jax
 
-        from . import env
+            from . import env
+            from .kvstore_transport import MeshTransport
 
-        self._jax = jax
-        # rendezvous happens at package import (MXNET_COORDINATOR env from
-        # tools/launch.py → _maybe_init_distributed, the analogue of
-        # ps-lite's DMLC_* env rendezvous / MXInitPSEnv); by the time a
-        # kvstore is created the multi-host runtime is already up
-        nproc = env.get("MXNET_NUM_PROCS")
-        if nproc > 1 and jax.process_count() != nproc:
-            raise MXNetError(
-                f"dist kvstore: jax runtime has {jax.process_count()} "
-                f"processes but MXNET_NUM_PROCS={nproc}; import mxnet_tpu "
-                "before any other jax use in launched workers"
-            )
+            # rendezvous happens at package import (MXNET_COORDINATOR env
+            # from tools/launch.py → _maybe_init_distributed, the analogue
+            # of ps-lite's DMLC_* env rendezvous / MXInitPSEnv); by the
+            # time a kvstore is created the multi-host runtime is up
+            nproc = env.get("MXNET_NUM_PROCS")
+            if nproc > 1 and jax.process_count() != nproc:
+                raise MXNetError(
+                    f"dist kvstore: jax runtime has {jax.process_count()} "
+                    f"processes but MXNET_NUM_PROCS={nproc}; import "
+                    "mxnet_tpu before any other jax use in launched workers"
+                )
+            transport = MeshTransport()
+        self._transport = transport
         # dist_async never reaches this class: create() routes it to the
         # host-side parameter server (kvstore_async.py)
 
     @property
     def rank(self):
-        return self._jax.process_index()
+        return self._transport.rank
 
     @property
     def num_workers(self):
-        return self._jax.process_count()
+        return self._transport.num_workers
 
     # --- cross-process data plane --------------------------------------
-    def _leader_mesh(self):
-        """The collective layer's GraftMesh: a ``dp`` axis over one device
-        per process — the reduction topology.
-
-        The reference reduces per-key on parameter servers over ZMQ
-        (kvstore_dist.h Push_/ZPush); here the reduction is one XLA
-        collective over ICI/DCN: each process contributes its locally
-        merged value as a shard of a global array, a jitted sum over the
-        ``dp`` axis all-reduces it, and every host reads back the
-        replicated result. Binding the same mesh abstraction the executor
-        uses keeps the whole distributed surface on one topology type.
-        """
-        if getattr(self, "_mesh", None) is None:
-            import jax
-
-            from .parallel.mesh import process_leader_mesh
-
-            self._mesh = process_leader_mesh()
-            # one jitted reducer per mesh — a fresh lambda per push would
-            # miss the pjit fastpath and retrace every step
-            self._reducer = jax.jit(
-                lambda a: a.sum(0),
-                out_shardings=self._mesh.replicated(),
-            )
-        return self._mesh
-
     def _allreduce(self, value):
-        """Sum an NDArray's value across all processes; returns jax array."""
-        import jax
-        import jax.numpy as jnp
-
-        if self.num_workers == 1:
-            return value._data
-        gm = self._leader_mesh()
-        my_leader = next(
-            d for d in gm.devices.flat if d.process_index == self.rank
-        )
-        local = jnp.asarray(value._data)[None]
-        local = jax.device_put(local, my_leader)
-        garr = jax.make_array_from_single_device_arrays(
-            (self.num_workers,) + tuple(value.shape),
-            gm.batch_sharding(),
-            [local],
-        )
-        return self._reducer(garr).addressable_data(0)
+        """Sum an NDArray's value across all processes; returns a backend
+        array (jax for the mesh transport). Kept as a method — the
+        imperative non-finite guard and the dist worker scripts reach it
+        directly — but the reduction itself lives in the transport."""
+        return self._transport.allreduce(value)
 
     def init(self, key, value):
         """Rank 0's value wins (reference: init runs once on the servers)."""
@@ -430,38 +397,24 @@ class DistKVStore(KVStore):
                 self._store[k] = merged
 
     def broadcast_ints(self, values):
-        """Rank 0's integer vector on every rank: rank 0 contributes the
-        values, everyone else zeros, one sum all-reduce — same
-        rank-0-wins pattern as :meth:`init`, and doubles as a barrier
-        (every rank leaves with the decision, or no rank does)."""
-        import numpy as np
-
-        vals = [int(v) for v in values]
+        """Rank 0's integer vector on every rank (rank-0-wins, and doubles
+        as a barrier: every rank leaves with the decision, or no rank
+        does). The transport owns the reduction; the PR-4 watchdog bounds
+        the wait — a dead peer must become a loud exit, not a silent
+        forever-hang."""
         if self.num_workers == 1:
-            return vals
-        from .ndarray import array as nd_array
-
-        contrib = np.asarray(vals if self.rank == 0 else [0] * len(vals),
-                             dtype=np.int64)
+            return [int(v) for v in values]
         with _CollectiveWatchdog("broadcast_ints", self.rank,
                                  self.num_workers, _kv_timeout()):
-            out = np.asarray(self._allreduce(nd_array(contrib)))
-        return [int(v) for v in out]
+            return self._transport.broadcast_ints(values)
 
     def barrier(self):
-        # an all-reduce of a scalar synchronises all hosts; must BLOCK —
-        # jax dispatch is async and a barrier that only enqueues is a race
-        import jax
-        import jax.numpy as jnp
-
         _tm.counter("kvstore.barrier").inc()
         if self.num_workers > 1:
-            from .ndarray import NDArray as _ND
-
             with _tm.span("kvstore.barrier_wait"), \
                     _CollectiveWatchdog("barrier", self.rank,
                                         self.num_workers, _kv_timeout()):
-                jax.block_until_ready(self._allreduce(_ND(jnp.ones((1,)))))
+                self._transport.barrier()
 
 
 def create(name="local"):
@@ -493,6 +446,16 @@ def create(name="local"):
 
         return AsyncDistKVStore(name)
     if "dist" in name:
+        from . import env
+
+        if (env.get("MXNET_KV_TRANSPORT") or "mesh").lower() == "tcp":
+            # the elastic plane: TCP transport, live membership epochs,
+            # straggler tolerance (kvstore_elastic.py). Selected by env —
+            # not by kvstore type — so launched jobs flip transports
+            # without touching model code.
+            from .kvstore_elastic import ElasticDistKVStore
+
+            return ElasticDistKVStore(name)
         return DistKVStore(name)
     return KVStore(name)
 

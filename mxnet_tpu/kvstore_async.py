@@ -43,6 +43,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 
 import numpy as np
 
@@ -51,16 +52,21 @@ from .kvstore import KVStore, _updater_key
 from . import telemetry as _tm
 
 # --- wire protocol ---------------------------------------------------------
-# frame: header | dims | key-utf8 | payload | [mac]
+# frame: header | dims | key-utf8 | payload | [crc32] | [mac]
 #   header: magic(4) ver(1) op(1) flags(1) dtype(1) ndim(1) klen(2) plen(8)
-#   flags: bit0 = expect_updater (push), bit1 = frame is HMAC-signed
+#   flags: bit0 = expect_updater (push), bit1 = frame is HMAC-signed,
+#          bit2 = crc32 trailer (integrity without a key: a corrupted frame
+#          must be DETECTED and rejected, never absorbed into weights)
 # Tensors travel as raw C-order bytes + (dtype code, dims). Parsing can
 # allocate at most MXNET_PS_MAX_FRAME bytes and interpret nothing as code.
 _MAGIC = b"MXPS"
 _WIRE_VERSION = 1
 _HDR = struct.Struct("<4sBBBBBHQ")
 _MAC_LEN = 32
+_CRC = struct.Struct("<I")
 _MAX_NDIM = 16
+
+_FLAG_UPDATER, _FLAG_MAC, _FLAG_CRC = 1, 2, 4
 
 _OP_INIT, _OP_PUSH, _OP_PULL, _OP_BARRIER, _OP_DONE, _OP_STOP = range(1, 7)
 _OP_OK, _OP_ERR, _OP_VAL = 16, 17, 18
@@ -69,7 +75,14 @@ _DTYPE_CODES = {
     np.dtype(np.float32): 0, np.dtype(np.float64): 1,
     np.dtype(np.float16): 2, np.dtype(np.int32): 3,
     np.dtype(np.int64): 4, np.dtype(np.uint8): 5,
+    np.dtype(np.int8): 7,
 }
+try:  # bf16 on the wire (gradient compression) — ml_dtypes ships with jax
+    import ml_dtypes as _ml_dtypes
+
+    _DTYPE_CODES[np.dtype(_ml_dtypes.bfloat16)] = 6
+except ImportError:  # pragma: no cover - jax always bundles ml_dtypes
+    _ml_dtypes = None
 _CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
 
 
@@ -91,7 +104,7 @@ def _max_frame():
     return env.get("MXNET_PS_MAX_FRAME")
 
 
-def _pack_frame(op, key="", arr=None, flags=0, secret=None):
+def _pack_frame(op, key="", arr=None, flags=0, secret=None, crc=False):
     if arr is not None:
         arr = np.ascontiguousarray(arr)
         code = _DTYPE_CODES.get(arr.dtype)
@@ -105,10 +118,14 @@ def _pack_frame(op, key="", arr=None, flags=0, secret=None):
         code, dims, payload = 0, (), b""
     kb = key.encode("utf-8")
     if secret is not None:
-        flags |= 2
+        flags |= _FLAG_MAC
+    if crc:
+        flags |= _FLAG_CRC
     body = _HDR.pack(_MAGIC, _WIRE_VERSION, op, flags, code, len(dims),
                      len(kb), len(payload))
     body += struct.pack(f"<{len(dims)}q", *dims) + kb + payload
+    if crc:
+        body += _CRC.pack(zlib.crc32(body))
     if secret is not None:
         body += hmac_mod.new(secret, body, hashlib.sha256).digest()
     return body
@@ -144,15 +161,23 @@ def _recv_frame(sock, secret=None):
             f"({_max_frame()})"
         )
     rest = _read_exact(sock, 8 * ndim + klen + plen)
+    crc_trailer = b""
+    if flags & _FLAG_CRC:
+        crc_trailer = _read_exact(sock, _CRC.size)
     if secret is not None:
-        if not flags & 2:
+        if not flags & _FLAG_MAC:
             raise _WireError("unsigned frame on a keyed server")
         mac = _read_exact(sock, _MAC_LEN)
-        want = hmac_mod.new(secret, hdr + rest, hashlib.sha256).digest()
+        want = hmac_mod.new(secret, hdr + rest + crc_trailer,
+                            hashlib.sha256).digest()
         if not hmac_mod.compare_digest(mac, want):
             raise _WireError("frame HMAC mismatch")
-    elif flags & 2:
+    elif flags & _FLAG_MAC:
         _read_exact(sock, _MAC_LEN)  # drain the unverifiable mac
+    if crc_trailer and _CRC.unpack(crc_trailer)[0] != zlib.crc32(hdr + rest):
+        # bit-flipped in transit (or a chaos fault): reject loudly — an
+        # absorbed corrupt gradient is silent model damage
+        raise _WireError("frame crc32 mismatch")
     dims = struct.unpack(f"<{ndim}q", rest[:8 * ndim])
     if any(d < 0 for d in dims):
         raise _WireError(f"negative dim in {dims}")
@@ -415,40 +440,63 @@ class AsyncDistKVStore(KVStore):
         atexit.register(self._at_exit)
 
     # --- transport ------------------------------------------------------
-    def _conn(self):
+    def _drop_conn(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _conn(self, deadline_s=None):
         if self._sock is None:
-            deadline = time.time() + 60
-            last = None
-            while time.time() < deadline:
-                try:
-                    s = socket.create_connection(self._addr, timeout=30)
-                    # RPCs may legitimately block far longer than the
-                    # connect timeout (barrier with a straggler, a push
-                    # waiting for the server optimizer)
-                    s.settimeout(None)
-                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    self._sock = s
-                    break
-                except OSError as e:  # server not up yet
-                    last = e
-                    time.sleep(0.1)
-            if self._sock is None:
-                raise MXNetError(f"dist_async: cannot reach server: {last}")
+            from .kvstore_transport import connect_with_backoff
+
+            self._sock = connect_with_backoff(
+                self._addr, deadline_s=deadline_s,
+                what="dist_async parameter server")
         return self._sock
 
-    def _rpc(self, op, key="", arr=None, flags=0):
+    def _rpc(self, op, key="", arr=None, flags=0, deadline_s=None):
+        """One request/response exchange, with mid-stream reconnect: a
+        broken or poisoned connection (``ConnectionError``/``_WireError``,
+        e.g. a server restart or a socket that died mid-frame) is retried
+        on a fresh socket with exponential backoff + jitter until the
+        ``MXNET_KV_RECONNECT`` window closes, then :class:`PeerUnreachable`
+        — typed, never a hang. Retrying means AT-LEAST-ONCE delivery: a
+        push whose ACK was lost can be applied twice, which dist_async's
+        hogwild semantics already tolerate (docs/distributed.md)."""
+        from .kvstore_transport import (PeerUnreachable, backoff_delay,
+                                        reconnect_window)
+
         secret = _wire_key()
-        try:
-            with self._sock_lock:
-                sock = self._conn()
-                sock.sendall(_pack_frame(op, key, arr, flags, secret))
-                rop, _, _, rarr = _recv_frame(sock, secret)
-        except (ConnectionError, OSError) as e:
-            raise MXNetError(
-                f"dist_async: lost the parameter server at {self._addr} "
-                f"({e}); rank 0 may have exited or timed out waiting for "
-                "stragglers"
-            ) from e
+        if deadline_s is None:
+            deadline_s = reconnect_window()
+        deadline = time.time() + deadline_s
+        attempt = 0
+        while True:
+            try:
+                with self._sock_lock:
+                    sock = self._conn(
+                        deadline_s=max(0.1, deadline - time.time()))
+                    sock.sendall(_pack_frame(op, key, arr, flags, secret))
+                    rop, _, _, rarr = _recv_frame(sock, secret)
+                break
+            except (ConnectionError, OSError, _WireError) as e:
+                with self._sock_lock:
+                    self._drop_conn()
+                attempt += 1
+                _tm.counter("kvstore_async.reconnect").inc()
+                left = deadline - time.time()
+                if left <= 0:
+                    raise PeerUnreachable(
+                        f"dist_async: lost the parameter server at "
+                        f"{self._addr[0]}:{self._addr[1]} ({e}); gave up "
+                        f"after {deadline_s:.0f}s of reconnect attempts "
+                        "(MXNET_KV_RECONNECT); rank 0 may have exited or "
+                        "timed out waiting for stragglers"
+                    ) from e
+                time.sleep(min(left, backoff_delay(attempt)))
         if rop == _OP_ERR:
             msg = rarr.tobytes().decode("utf-8") if rarr is not None else ""
             raise MXNetError(f"dist_async server: {msg}")
@@ -552,7 +600,9 @@ class AsyncDistKVStore(KVStore):
         if not self._done_sent:
             self._done_sent = True
             try:
-                self._rpc(_OP_DONE)
+                # short reconnect window: a gone server at exit is normal
+                # (rank 0 shut down) and must not stall interpreter exit
+                self._rpc(_OP_DONE, deadline_s=5)
             except (MXNetError, OSError):
                 pass
         if self._server is not None:

@@ -1,0 +1,259 @@
+"""CollectiveTransport — the pluggable layer under ``DistKVStore``.
+
+Reference: ps-lite's ``Van`` (the transport under the KVStore worker/server
+protocol: ZMQ sockets, connect/retry, heartbeats to the scheduler,
+``ps-lite/src/van.cc``). The reference separates WHAT the kvstore does
+(init/push/pull/barrier) from HOW bytes move between hosts; this module
+restores that seam for the TPU-native store.
+
+Two implementations:
+
+* :class:`MeshTransport` — the in-process ``process_leader_mesh`` leaders:
+  every collective is one jitted XLA reduction over a ``dp`` axis with one
+  device per process (ICI/DCN). Membership is *static* — the jax runtime
+  pins the process count at initialize and cannot re-admit a rank — so this
+  transport reports a frozen epoch and the launcher's whole-job restart
+  remains the recovery story (docs/robustness.md).
+* :class:`TcpTransport` (kvstore_elastic.py) — a host-side TCP plane grown
+  out of kvstore_async.py's typed frame protocol, with connect/retry/
+  backoff, heartbeats, and a rank-0-owned *membership table* versioned by
+  monotonically increasing epochs. Workers can die, lag and join mid-job;
+  the collective completes over the survivors and every reply carries the
+  epoch so clients observe the change (docs/distributed.md).
+
+``DistKVStore`` routes every cross-process primitive (allreduce /
+broadcast_ints / barrier) through whichever transport it was constructed
+with; ``MXNET_KV_TRANSPORT`` selects at ``create()`` time.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import time
+
+from .base import MXNetError
+from . import telemetry as _tm
+
+
+class PeerUnreachable(MXNetError):
+    """A remote kvstore peer (server or member) could not be reached within
+    the reconnect window (``MXNET_KV_RECONNECT``) — the typed alternative
+    to hanging in a retry loop forever."""
+
+
+class MembershipChanged(MXNetError):
+    """The membership epoch moved under an operation (worker join/leave/
+    death). Carries enough for ``Module.fit`` to run the fenced reshard:
+    the new epoch, the new dp degree, and the coordinator's consensus
+    cursor (epoch_idx, nbatch) agreed at the fence."""
+
+    def __init__(self, old_epoch, new_epoch, num_workers, cursor=None):
+        super().__init__(
+            f"kvstore membership epoch moved {old_epoch} -> {new_epoch} "
+            f"(now {num_workers} workers)")
+        self.old_epoch = old_epoch
+        self.new_epoch = new_epoch
+        self.num_workers = num_workers
+        self.cursor = cursor
+
+
+class ElasticServerLost(MXNetError):
+    """The elastic coordinator restarted and lost its in-memory store: a
+    key this client initialized earlier is gone. ``Module.fit`` recovers by
+    re-seeding the server from the executor's live parameters
+    (kvstore_elastic.reseed_after_coordinator_restart)."""
+
+
+def reconnect_window():
+    from . import env as _env
+
+    return float(_env.get("MXNET_KV_RECONNECT"))
+
+
+def backoff_delay(attempt, base=0.05, cap=1.0):
+    """Exponential backoff with full jitter (attempt is 1-based). Jitter
+    decorrelates reconnect storms when many workers chase one restarted
+    coordinator."""
+    return random.uniform(0, min(cap, base * (2 ** (attempt - 1))))
+
+
+def connect_with_backoff(addr, deadline_s=None, what="kvstore peer"):
+    """Dial ``addr`` with exponential backoff + jitter until ``deadline_s``
+    seconds elapse, then raise :class:`PeerUnreachable` (typed, not a
+    hang). Returns a connected TCP socket with NODELAY set and no read
+    timeout (RPCs may legitimately block across a straggler's round)."""
+    if deadline_s is None:
+        deadline_s = reconnect_window()
+    deadline = time.time() + deadline_s
+    attempt = 0
+    last = None
+    while True:
+        attempt += 1
+        try:
+            s = socket.create_connection(addr, timeout=30)
+            s.settimeout(None)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return s
+        except OSError as e:
+            last = e
+            left = deadline - time.time()
+            if left <= 0:
+                raise PeerUnreachable(
+                    f"cannot reach {what} at {addr[0]}:{addr[1]} after "
+                    f"{deadline_s:.0f}s (MXNET_KV_RECONNECT): {last}"
+                ) from e
+            time.sleep(min(left, backoff_delay(attempt)))
+
+
+class CollectiveTransport:
+    """The collective layer's interface: rank/size identity plus the three
+    cross-process primitives the store is built from. Implementations own
+    their liveness story; epoch() is 0-and-frozen for static transports."""
+
+    name = "abstract"
+
+    @property
+    def rank(self):
+        raise NotImplementedError
+
+    @property
+    def num_workers(self):
+        raise NotImplementedError
+
+    def allreduce(self, value, key="", clock=0):
+        """Sum ``value`` (an NDArray) across the live membership; returns
+        a backend array (jax or numpy) every member agrees on."""
+        raise NotImplementedError
+
+    def broadcast_ints(self, values):
+        """Rank 0's small integer vector, agreed on every member."""
+        raise NotImplementedError
+
+    def barrier(self):
+        raise NotImplementedError
+
+    def epoch(self):
+        """Current membership epoch (monotonic; static transports pin 0)."""
+        return 0
+
+    def close(self):
+        pass
+
+
+class MeshTransport(CollectiveTransport):
+    """The existing in-process leaders: one XLA collective over a ``dp``
+    GraftMesh with one device per process. Static membership (the jax
+    runtime cannot re-admit a rank); recovery = supervised whole-job
+    restart + checkpoint resume."""
+
+    name = "mesh"
+
+    def __init__(self):
+        import jax
+
+        self._jax = jax
+        self._mesh = None
+        self._reducer = None
+
+    @property
+    def rank(self):
+        return self._jax.process_index()
+
+    @property
+    def num_workers(self):
+        return self._jax.process_count()
+
+    def _leader_mesh(self):
+        """The collective layer's GraftMesh: a ``dp`` axis over one device
+        per process — the reduction topology.
+
+        The reference reduces per-key on parameter servers over ZMQ
+        (kvstore_dist.h Push_/ZPush); here the reduction is one XLA
+        collective over ICI/DCN: each process contributes its locally
+        merged value as a shard of a global array, a jitted sum over the
+        ``dp`` axis all-reduces it, and every host reads back the
+        replicated result. Binding the same mesh abstraction the executor
+        uses keeps the whole distributed surface on one topology type.
+        """
+        if self._mesh is None:
+            import jax
+
+            from .parallel.mesh import process_leader_mesh
+
+            self._mesh = process_leader_mesh()
+            # one jitted reducer per mesh — a fresh lambda per push would
+            # miss the pjit fastpath and retrace every step
+            self._reducer = jax.jit(
+                lambda a: a.sum(0),
+                out_shardings=self._mesh.replicated(),
+            )
+        return self._mesh
+
+    def allreduce(self, value, key="", clock=0):
+        """Sum an NDArray's value across all processes; returns jax array."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.num_workers == 1:
+            return value._data
+        gm = self._leader_mesh()
+        my_leader = next(
+            d for d in gm.devices.flat if d.process_index == self.rank
+        )
+        local = jnp.asarray(value._data)[None]
+        local = jax.device_put(local, my_leader)
+        garr = jax.make_array_from_single_device_arrays(
+            (self.num_workers,) + tuple(value.shape),
+            gm.batch_sharding(),
+            [local],
+        )
+        return self._reducer(garr).addressable_data(0)
+
+    def broadcast_ints(self, values):
+        """Rank 0 contributes the values, everyone else zeros, one sum
+        all-reduce — rank-0-wins, and doubles as a barrier."""
+        import numpy as np
+
+        from .ndarray import array as nd_array
+
+        vals = [int(v) for v in values]
+        if self.num_workers == 1:
+            return vals
+        contrib = np.asarray(vals if self.rank == 0 else [0] * len(vals),
+                             dtype=np.int64)
+        out = np.asarray(self.allreduce(nd_array(contrib)))
+        return [int(v) for v in out]
+
+    def barrier(self):
+        # an all-reduce of a scalar synchronises all hosts; must BLOCK —
+        # jax dispatch is async and a barrier that only enqueues is a race
+        import jax
+        import jax.numpy as jnp
+
+        if self.num_workers > 1:
+            from .ndarray import NDArray as _ND
+
+            jax.block_until_ready(self.allreduce(_ND(jnp.ones((1,)))))
+
+
+def make_transport(kind=None):
+    """Build the transport ``MXNET_KV_TRANSPORT`` names (``mesh`` default;
+    ``tcp`` = the elastic plane). Unknown names fail loudly — a typo must
+    not silently train un-reduced."""
+    if kind is None:
+        from . import env as _env
+
+        kind = _env.get("MXNET_KV_TRANSPORT")
+    kind = (kind or "mesh").lower()
+    if kind == "mesh":
+        _tm.counter("kvstore.transport_mesh").inc()
+        return MeshTransport()
+    if kind == "tcp":
+        from .kvstore_elastic import TcpTransport
+
+        _tm.counter("kvstore.transport_tcp").inc()
+        return TcpTransport()
+    raise MXNetError(
+        f"MXNET_KV_TRANSPORT={kind!r}: unknown transport (accepted: "
+        "'mesh', 'tcp')")

@@ -1,0 +1,96 @@
+"""Worker for the elastic-training chaos suite (tests/test_elastic_train.py).
+
+Each rank trains the same tiny MLP on its own data shard through the
+elastic TCP kvstore (``MXNET_KV_TRANSPORT=tcp``), driving ``Module.fit``
+end-to-end: gradient rounds over the live membership, per-batch
+membership-event polling, fenced resharding on kill/join, and
+coordinator-restart re-seeding. Prints one machine-checkable line per
+rank plus the telemetry counters the tests assert on.
+
+Knobs (env, all optional):
+  ELASTIC_EPOCHS        epochs to train (default 30)
+  ELASTIC_BATCH_SLEEP   seconds to sleep per batch (stretches wall time so
+                        the test can kill/add workers mid-run)
+  ELASTIC_MIN_ACC       accuracy floor to assert (default 0.8; the oracle
+                        tolerance — a clean dp-static run reaches ~0.95)
+  ELASTIC_SKIP_ASSERT   "1": print the accuracy but do not assert (used by
+                        late joiners that only see the tail of the run)
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry as tm
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert type(kv).__name__ == "ElasticDistKVStore", type(kv)
+
+    rng = np.random.RandomState(42)
+    X = rng.randn(128, 10).astype(np.float32)
+    W = rng.randn(10, 4).astype(np.float32)
+    Y = X.dot(W).argmax(1).astype(np.float32)
+    Xs, Ys = X[rank::nw], Y[rank::nw]
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=16, name="fc1"),
+        act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=4, name="fc2"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(Xs, Ys, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mx.random.seed(7)  # same init on every rank
+    mod.init_params(initializer=mx.init.Xavier())
+
+    sleep_s = float(os.environ.get("ELASTIC_BATCH_SLEEP", "0") or 0)
+    cb = None
+    if sleep_s > 0:
+        def cb(_param):
+            time.sleep(sleep_s)
+
+    epochs = int(os.environ.get("ELASTIC_EPOCHS", "30"))
+    metric = mx.metric.Accuracy()
+    mod.fit(
+        it, num_epoch=epochs, eval_metric=metric, kvstore=kv,
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.2, "rescale_grad": 1.0 / nw},
+        batch_end_callback=cb,
+        initializer=None,
+    )
+    acc = metric.get()[1]
+
+    snap = tm.snapshot().get("kvstore", {})
+
+    def val(k):  # gauges render as {'value': ..., 'max': ...}
+        v = snap.get(k, 0)
+        return v.get("value", 0) if isinstance(v, dict) else v
+
+    stats = " ".join(
+        f"{k}={val(k)}"
+        for k in ("membership_epoch", "membership_size", "membership_join",
+                  "peer_dead", "peer_leave", "reshard", "elastic_reseed",
+                  "drop_slowest", "compress_push", "corrupt_frame_rejected",
+                  "elastic_reconnect"))
+    print(f"rank {rank} ELASTIC-STATS {stats}", flush=True)
+    if os.environ.get("ELASTIC_SKIP_ASSERT") != "1":
+        floor = float(os.environ.get("ELASTIC_MIN_ACC", "0.8"))
+        assert acc > floor, \
+            f"rank {rank}: elastic training stuck at {acc} (floor {floor})"
+    print(f"rank {rank} ELASTIC-TRAIN OK acc={acc:.3f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

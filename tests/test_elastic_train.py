@@ -1,0 +1,287 @@
+"""Elastic multi-host training: the kill/join chaos suite.
+
+Real subprocess workers (tests/elastic_worker.py) drive ``Module.fit``
+end-to-end over the elastic TCP kvstore (MXNET_KV_TRANSPORT=tcp). Ranks
+are spawned DIRECTLY (not via tools/launch.py) so one rank's engineered
+death doesn't trigger any launcher-level teardown — the point is that the
+SURVIVORS finish on their own. Every leg asserts convergence within the
+oracle loss tolerance plus the membership counters that prove the
+machinery (not luck) carried the run.
+
+All legs are slow-marked: tier-1 keeps its alphabetical-prefix budget, and
+``-m chaos`` selects the suite alone.
+"""
+
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_WORKER = os.path.join(_ROOT, "tests", "elastic_worker.py")
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos, pytest.mark.sanitize]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _base_env(num_workers, ps_port, **extra):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({
+        "MXNET_KV_TRANSPORT": "tcp",
+        "MXNET_COORDINATOR": f"127.0.0.1:{_free_port()}",
+        "MXNET_PS_PORT": str(ps_port),
+        "MXNET_NUM_PROCS": str(num_workers),
+        "MXNET_KV_HEARTBEAT_MS": "200",
+        "MXNET_KV_PEER_TIMEOUT": "3",
+        "MXNET_KV_RECONNECT": "30",
+        "MXNET_KV_TIMEOUT": "120",  # any hang becomes a typed exit 41
+        "MXNET_PS_EXIT_TIMEOUT": "15",
+    })
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _spawn(rank, env, **extra):
+    e = dict(env)
+    e["MXNET_PROC_ID"] = str(rank)
+    e.update({k: str(v) for k, v in extra.items()})
+    return subprocess.Popen(
+        [sys.executable, _WORKER], env=e,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
+def _finish(proc, timeout):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        raise AssertionError(
+            f"worker pid {proc.pid} hung (zero-hang guarantee violated):\n"
+            f"{out[-4000:]}")
+    return out
+
+
+def _stat(out, name):
+    m = re.search(rf"ELASTIC-STATS .*\b{name}=(\d+)", out)
+    assert m, f"no {name} in ELASTIC-STATS:\n{out[-4000:]}"
+    return int(m.group(1))
+
+
+def test_kill_one_mid_epoch_survivor_converges_at_dp_minus_1():
+    """Chaos leg 1: rank 1 hard-dies (faultinject os._exit, no LEAVE, no
+    cleanup) mid-epoch. The survivor must detect the death by heartbeat
+    silence, reshard to dp-1 at the next fence, keep training, and land
+    within the oracle loss tolerance — with the counters to prove the
+    path taken."""
+    env = _base_env(2, _free_port())
+    p0 = _spawn(0, env)
+    p1 = _spawn(1, env, MXNET_FI_KV_KILL_RANK=1, MXNET_FI_KV_KILL_AT_BATCH=6,
+                ELASTIC_SKIP_ASSERT=1)
+    out1 = _finish(p1, 180)
+    assert p1.returncode == 17, f"rank 1 rc={p1.returncode}:\n{out1[-2000:]}"
+    assert "KV-KILL rank 1 at train batch 6" in out1, out1[-2000:]
+    out0 = _finish(p0, 300)
+    assert p0.returncode == 0, f"survivor rc={p0.returncode}:\n{out0[-4000:]}"
+    assert "rank 0 ELASTIC-TRAIN OK" in out0, out0[-4000:]
+    # counter-verified: the death was DETECTED and the membership epoch
+    # advanced through a fenced reshard — not a silent lucky run
+    assert _stat(out0, "peer_dead") >= 1, out0[-4000:]
+    assert _stat(out0, "reshard") >= 1, out0[-4000:]
+    assert _stat(out0, "membership_epoch") >= 3, out0[-4000:]
+    assert _stat(out0, "membership_size") == 1, out0[-4000:]
+
+
+def test_worker_joins_at_next_fence():
+    """Chaos leg 2: a worker added mid-run is admitted at the next fence;
+    incumbents observe the membership event, reshard to dp+1, and keep
+    training to convergence. The joiner fast-forwards onto the live round
+    line and finishes cleanly."""
+    env = _base_env(2, _free_port(), ELASTIC_BATCH_SLEEP="0.05",
+                    ELASTIC_EPOCHS="40")
+    p0 = _spawn(0, env)
+    p1 = _spawn(1, env)
+    time.sleep(4)  # let the incumbents get well into training
+    p2 = _spawn(2, env, MXNET_NUM_PROCS=3, ELASTIC_SKIP_ASSERT=1,
+                ELASTIC_EPOCHS=10)
+    out2 = _finish(p2, 240)
+    out0 = _finish(p0, 240)
+    out1 = _finish(p1, 240)
+    assert p2.returncode == 0, f"joiner rc={p2.returncode}:\n{out2[-4000:]}"
+    assert p0.returncode == 0, f"rank 0 rc={p0.returncode}:\n{out0[-4000:]}"
+    assert p1.returncode == 0, f"rank 1 rc={p1.returncode}:\n{out1[-4000:]}"
+    assert "rank 0 ELASTIC-TRAIN OK" in out0
+    assert "rank 1 ELASTIC-TRAIN OK" in out1
+    # incumbents saw the join as a membership event and fenced through it
+    assert _stat(out0, "membership_join") >= 1
+    assert _stat(out0, "reshard") >= 1, out0[-4000:]
+    assert _stat(out1, "reshard") >= 1, out1[-4000:]
+
+
+def test_coordinator_restart_recovers_via_reseed():
+    """Chaos leg 3: rank 0 — the membership coordinator itself — dies and
+    is relaunched (same rank, MXNET_NUM_RESTARTS bumped). The survivor
+    detects the fresh server incarnation (boot nonce), re-seeds the master
+    weights from its live params, and BOTH ranks finish within
+    tolerance."""
+    ps_port = _free_port()
+    env = _base_env(2, ps_port)
+    p0 = _spawn(0, env, MXNET_FI_KV_KILL_RANK=0, MXNET_FI_KV_KILL_AT_BATCH=6,
+                MXNET_FI_ATTEMPT=0, ELASTIC_SKIP_ASSERT=1)
+    p1 = _spawn(1, env, MXNET_FI_ATTEMPT=0)
+    out0 = _finish(p0, 180)
+    assert p0.returncode == 17, f"rank 0 rc={p0.returncode}:\n{out0[-2000:]}"
+    # supervised per-rank restart: same rank id, restart count bumped so
+    # the kill schedule (pinned to attempt 0) does not re-fire
+    p0b = _spawn(0, env, MXNET_FI_KV_KILL_RANK=0,
+                 MXNET_FI_KV_KILL_AT_BATCH=6, MXNET_FI_ATTEMPT=0,
+                 MXNET_NUM_RESTARTS=1, ELASTIC_SKIP_ASSERT=1)
+    out1 = _finish(p1, 300)
+    out0b = _finish(p0b, 300)
+    assert p1.returncode == 0, f"survivor rc={p1.returncode}:\n{out1[-4000:]}"
+    assert p0b.returncode == 0, \
+        f"restarted rank 0 rc={p0b.returncode}:\n{out0b[-4000:]}"
+    assert "rank 1 ELASTIC-TRAIN OK" in out1, out1[-4000:]
+    # the survivor re-seeded the restarted coordinator's empty store from
+    # its live parameters instead of training from scratch (or hanging)
+    assert _stat(out1, "elastic_reseed") >= 1, out1[-4000:]
+
+
+def test_compression_trains_within_tolerance():
+    """Straggler-mitigation leg: int8 gradient compression with error
+    feedback trains to the same oracle tolerance; the compression path is
+    counter-verified on every rank."""
+    env = _base_env(2, _free_port(), MXNET_KV_COMPRESS="int8")
+    p0 = _spawn(0, env)
+    p1 = _spawn(1, env)
+    out0 = _finish(p0, 300)
+    out1 = _finish(p1, 300)
+    assert p0.returncode == 0, f"rank 0 rc={p0.returncode}:\n{out0[-4000:]}"
+    assert p1.returncode == 0, f"rank 1 rc={p1.returncode}:\n{out1[-4000:]}"
+    assert "rank 0 ELASTIC-TRAIN OK" in out0
+    assert "rank 1 ELASTIC-TRAIN OK" in out1
+    assert _stat(out0, "compress_push") > 0
+    assert _stat(out1, "compress_push") > 0
+
+
+def test_tcp_watchdog_converts_stall_to_exit_41(tmp_path):
+    """Zero-hang guarantee, elastic plane: a peer that heartbeats (alive)
+    but never contributes to a round stalls the survivor's blocking pull;
+    the PR-4 watchdog must convert that into a diagnosed exit 41 instead
+    of an unbounded hang. (Mesh-plane twin: test_watchdog_stall below.)"""
+    script = str(tmp_path / "stall.py")
+    with open(script, "w") as f:
+        f.write(
+            "import os, time\n"
+            "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+            "import numpy as np\n"
+            "import mxnet_tpu as mx\n"
+            "kv = mx.kv.create('dist_sync')\n"
+            "kv.init(0, mx.nd.array(np.zeros(2, np.float32)))\n"
+            "if kv.rank == 0:\n"
+            "    kv.push(0, mx.nd.array(np.ones(2, np.float32)))\n"
+            "    o = mx.nd.array(np.zeros(2, np.float32))\n"
+            "    kv.pull(0, out=o)  # blocks: rank 1 never pushes\n"
+            "    print('rank 0 unexpectedly unblocked', flush=True)\n"
+            "else:\n"
+            "    time.sleep(60)  # heartbeating, never pushing\n"
+        )
+    env = _base_env(2, _free_port(), MXNET_KV_TIMEOUT="5",
+                    MXNET_KV_PEER_TIMEOUT="600")
+    procs = [subprocess.Popen(
+        [sys.executable, script],
+        env={**env, "MXNET_PROC_ID": str(r)},
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    out0 = _finish(procs[0], 120)
+    assert procs[0].returncode == 41, \
+        f"rc={procs[0].returncode}:\n{out0[-3000:]}"
+    assert "blocked in 'elastic pull'" in out0, out0[-3000:]
+    procs[1].send_signal(signal.SIGTERM)
+    procs[1].wait(timeout=30)
+
+
+def test_elastic_launcher_restarts_single_rank(tmp_path):
+    """launch.py --elastic: a dead rank is relaunched ALONE with its old
+    rank id and a bumped per-rank MXNET_NUM_RESTARTS, while the other
+    ranks are left untouched (contrast: the mesh plane's whole-job
+    restart)."""
+    marker = str(tmp_path / "died_once")
+    script = str(tmp_path / "flaky.py")
+    with open(script, "w") as f:
+        f.write(
+            "import os, sys, time\n"
+            f"marker = {marker!r}\n"
+            "rank = os.environ['MXNET_PROC_ID']\n"
+            "assert os.environ['MXNET_KV_TRANSPORT'] == 'tcp'\n"
+            "if rank == '1' and not os.path.exists(marker):\n"
+            "    open(marker, 'w').close()\n"
+            "    sys.exit(3)  # simulated crash on first life\n"
+            "time.sleep(1)  # outlive the relaunch so lives overlap\n"
+            "nr = os.environ['MXNET_NUM_RESTARTS']\n"
+            "print(f'rank {rank} alive restarts={nr}', flush=True)\n"
+        )
+    env = dict(os.environ)
+    cmd = [
+        sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+        "-n", "2", "--launcher", "local", "--port", str(_free_port()),
+        "--elastic", "--max-restarts", "1",
+        sys.executable, script,
+    ]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=120)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    assert "per-rank restart (attempt 1, budget 1/1)" in out, out
+    # rank 0 was never restarted; rank 1's second life sees its own count
+    assert "rank 0 alive restarts=0" in out, out
+    assert "rank 1 alive restarts=1" in out, out
+
+    # with no restart budget the job fails and reports the dead rank
+    os.unlink(marker)
+    cmd[cmd.index("--max-restarts") + 1] = "0"
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=120)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode != 0
+    assert "restart budget spent" in out
+
+
+@pytest.mark.dist_multiprocess
+def test_mesh_watchdog_converts_stall_to_exit_41():
+    """Satellite: the PR-4 collective watchdog end-to-end on the MESH
+    plane — rank 1 stalls before barrier 2, rank 0 blocks inside the XLA
+    collective, and the watchdog exits 41 with the actionable diagnostic
+    (supervisor then reports the dead rank)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXNET_KV_TIMEOUT"] = "6"
+    cmd = [
+        sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+        "-n", "2", "--launcher", "local", "--port", str(_free_port()),
+        sys.executable,
+        os.path.join(_ROOT, "tests", "watchdog_stall_worker.py"),
+    ]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=300)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode != 0, out[-4000:]
+    assert "blocked in 'barrier'" in out, out[-4000:]
+    assert "rank 0 died (rc=41)" in out, out[-4000:]

@@ -1,0 +1,502 @@
+"""Unit tests for the pluggable kvstore transport layer.
+
+Fast, in-process, CPU-only: the wire-protocol hardening (crc32 trailer,
+bf16/int8 dtype codes), the reconnect/backoff client machinery (a socket
+that dies mid-frame must be retried, a gone server must become a TYPED
+error), the CollectiveTransport seam under DistKVStore, and the elastic
+coordinator's round/membership state machine driven by real sockets and
+threads. Subprocess chaos legs live in tests/test_elastic_train.py
+(slow-marked).
+"""
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import kvstore_async as ka
+from mxnet_tpu import kvstore_elastic as ke
+from mxnet_tpu import kvstore_transport as kt
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.base import MXNetError
+
+# the elastic coordinator + clients run as real threads in-process: tier-1
+# runs this whole file under the runtime lock-order sanitizer
+pytestmark = pytest.mark.sanitize
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _counter(name):
+    group, _, leaf = name.partition(".")
+    return tm.snapshot().get(group, {}).get(leaf, 0)
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: crc32 trailer + new dtype codes
+
+
+def test_crc_frame_roundtrip_and_corruption_detected():
+    a, b = socket.socketpair()
+    try:
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        frame = ka._pack_frame(ka._OP_PUSH, "w0", arr, crc=True)
+        a.sendall(frame)
+        op, flags, k, got = ka._recv_frame(b)
+        assert op == ka._OP_PUSH and k == "w0"
+        assert flags & ka._FLAG_CRC
+        np.testing.assert_array_equal(got, arr)
+
+        # flip one payload byte: the crc32 trailer must catch it
+        bad = bytearray(frame)
+        bad[len(bad) // 2] ^= 0xFF
+        a.sendall(bytes(bad))
+        with pytest.raises(ka._WireError):
+            ka._recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_crc_with_hmac_covers_trailer():
+    a, b = socket.socketpair()
+    key = b"k" * 32
+    try:
+        arr = np.ones(5, dtype=np.float32)
+        frame = ka._pack_frame(ka._OP_PUSH, "w0", arr, secret=key, crc=True)
+        a.sendall(frame)
+        op, _, _, got = ka._recv_frame(b, secret=key)
+        assert op == ka._OP_PUSH
+        np.testing.assert_array_equal(got, arr)
+
+        # corrupt the crc trailer itself: the MAC is computed over it,
+        # so tampering there is also unauthenticated
+        bad = bytearray(frame)
+        bad[-36] ^= 0x01  # inside the 4-byte crc, before the 32-byte mac
+        a.sendall(bytes(bad))
+        with pytest.raises(ka._WireError):
+            ka._recv_frame(b, secret=key)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_int8_and_bf16_dtype_codes_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        q = np.array([-127, 0, 42, 127], dtype=np.int8)
+        a.sendall(ka._pack_frame(ka._OP_PUSH, "g", q, crc=True))
+        _, _, _, got = ka._recv_frame(b)
+        assert got.dtype == np.int8
+        np.testing.assert_array_equal(got, q)
+
+        try:
+            import ml_dtypes
+        except ImportError:
+            pytest.skip("ml_dtypes unavailable")
+        h = np.arange(4, dtype=np.float32).astype(ml_dtypes.bfloat16)
+        a.sendall(ka._pack_frame(ka._OP_PUSH, "h", h, crc=True))
+        _, _, _, got = ka._recv_frame(b)
+        assert got.dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(got.astype(np.float32),
+                                      h.astype(np.float32))
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# reconnect machinery
+
+
+def test_backoff_delay_is_jittered_and_capped():
+    for attempt in range(1, 12):
+        for _ in range(20):
+            d = kt.backoff_delay(attempt, base=0.05, cap=1.0)
+            assert 0 <= d <= min(1.0, 0.05 * 2 ** (attempt - 1))
+
+
+def test_connect_with_backoff_raises_typed_error():
+    port = _free_port()  # nothing listens here
+    t0 = time.time()
+    with pytest.raises(kt.PeerUnreachable) as ei:
+        kt.connect_with_backoff(("127.0.0.1", port), deadline_s=0.4,
+                                what="unit test peer")
+    assert time.time() - t0 < 30
+    assert "MXNET_KV_RECONNECT" in str(ei.value)
+
+
+def test_async_rpc_survives_socket_death_mid_frame(monkeypatch):
+    """Satellite: the dist_async client must reconnect (backoff+jitter)
+    when the server connection dies mid-frame, and the retried RPC must
+    succeed against the recovered server."""
+    port = _free_port()
+    lis = socket.socket()
+    lis.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lis.bind(("127.0.0.1", port))
+    lis.listen(4)
+
+    def server():
+        # first connection: read a bit, answer with HALF a frame, die
+        conn, _ = lis.accept()
+        conn.recv(64)
+        conn.sendall(ka._HDR.pack(b"MXPS", 1, ka._OP_OK, 0, 0, 0, 0, 0)[:9])
+        conn.close()
+        # second connection: speak the real protocol
+        conn, _ = lis.accept()
+        op, flags, key, arr = ka._recv_frame(conn)
+        assert op == ka._OP_PUSH and key == "w0"
+        conn.sendall(ka._pack_frame(ka._OP_OK))
+        conn.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    monkeypatch.setenv("MXNET_PROC_ID", "1")
+    monkeypatch.setenv("MXNET_NUM_PROCS", "2")
+    monkeypatch.setenv("MXNET_PS_PORT", str(port))
+    monkeypatch.delenv("MXNET_PS_KEY", raising=False)
+    kv = ka.AsyncDistKVStore.__new__(ka.AsyncDistKVStore)
+    from mxnet_tpu.kvstore import KVStore
+
+    KVStore.__init__(kv, "dist_async")
+    kv._rank, kv._size = 1, 2
+    kv._server = None
+    kv._addr = ("127.0.0.1", port)
+    kv._sock = None
+    kv._sock_lock = threading.Lock()
+    kv._has_optimizer = False
+    before = _counter("kvstore_async.reconnect")
+    kv._rpc(ka._OP_PUSH, "w0", np.zeros(3, np.float32))
+    assert _counter("kvstore_async.reconnect") > before
+    t.join(5)
+    lis.close()
+
+
+def test_async_rpc_gone_server_is_typed_not_hang(monkeypatch):
+    monkeypatch.setenv("MXNET_KV_RECONNECT", "0.5")
+    monkeypatch.delenv("MXNET_PS_KEY", raising=False)
+    kv = ka.AsyncDistKVStore.__new__(ka.AsyncDistKVStore)
+    from mxnet_tpu.kvstore import KVStore
+
+    KVStore.__init__(kv, "dist_async")
+    kv._rank, kv._size = 1, 2
+    kv._server = None
+    kv._addr = ("127.0.0.1", _free_port())
+    kv._sock = None
+    kv._sock_lock = threading.Lock()
+    kv._has_optimizer = False
+    t0 = time.time()
+    with pytest.raises(kt.PeerUnreachable):
+        kv._rpc(ka._OP_PUSH, "w0", np.zeros(3, np.float32))
+    assert time.time() - t0 < 30
+
+
+# ---------------------------------------------------------------------------
+# the CollectiveTransport seam
+
+
+class _FakeTransport(kt.CollectiveTransport):
+    name = "fake"
+
+    def __init__(self):
+        self.calls = []
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 2
+
+    def allreduce(self, value, key="", clock=0):
+        self.calls.append("allreduce")
+        return value._data
+
+    def broadcast_ints(self, values):
+        self.calls.append("broadcast")
+        return [int(v) for v in values]
+
+    def barrier(self):
+        self.calls.append("barrier")
+
+
+def test_dist_kvstore_routes_through_injected_transport(monkeypatch):
+    from mxnet_tpu.kvstore import DistKVStore
+
+    monkeypatch.setenv("MXNET_KV_TIMEOUT", "0")
+    tr = _FakeTransport()
+    kv = DistKVStore("dist_sync", transport=tr)
+    assert kv.rank == 0 and kv.num_workers == 2
+    assert kv.broadcast_ints([3, 4]) == [3, 4]
+    kv.barrier()
+    assert "broadcast" in tr.calls and "barrier" in tr.calls
+
+
+def test_make_transport_unknown_kind_fails_loudly(monkeypatch):
+    monkeypatch.setenv("MXNET_KV_TRANSPORT", "carrier-pigeon")
+    with pytest.raises(MXNetError):
+        kt.make_transport()
+
+
+def test_mesh_transport_single_process_identities():
+    tr = kt.MeshTransport()
+    assert tr.num_workers == 1
+    assert tr.broadcast_ints([5, 6]) == [5, 6]
+    tr.barrier()  # no-op, must not raise
+    assert tr.epoch() == 0
+
+
+# ---------------------------------------------------------------------------
+# elastic coordinator state machine (real sockets, fast timeouts)
+
+
+def _pair(monkeypatch, **env):
+    """One in-process coordinator + two clients on a fresh port."""
+    monkeypatch.setenv("MXNET_KV_HEARTBEAT_MS", "100")
+    monkeypatch.setenv("MXNET_KV_PEER_TIMEOUT", "2.0")
+    monkeypatch.setenv("MXNET_KV_RECONNECT", "10")
+    monkeypatch.setenv("MXNET_PS_EXIT_TIMEOUT", "5")
+    monkeypatch.delenv("MXNET_PS_KEY", raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    addr = ("127.0.0.1", _free_port())
+    kv0 = ke.ElasticDistKVStore(rank=0, num_workers=2, addr=addr,
+                                run_server=True)
+    kv1 = ke.ElasticDistKVStore(rank=1, num_workers=2, addr=addr,
+                                run_server=False)
+    return kv0, kv1
+
+
+def _close(*kvs):
+    # clients first, coordinator last: rank 0's close waits for everyone
+    # else to LEAVE before tearing the server down
+    for kv in reversed(kvs):
+        try:
+            kv.close()
+        except MXNetError:
+            pass
+
+
+def test_elastic_round_reduces_and_replies_carry_epoch(monkeypatch):
+    import mxnet_tpu as mx
+
+    kv0, kv1 = _pair(monkeypatch)
+    try:
+        for kv in (kv0, kv1):
+            kv.init(0, mx.nd.array(np.zeros(4, np.float32)))
+        outs = {}
+
+        def step(kv, tag):
+            kv.push(0, mx.nd.array(np.full(4, kv.rank + 1.0, np.float32)))
+            o = mx.nd.array(np.zeros(4, np.float32))
+            kv.pull(0, out=o)
+            outs[tag] = o.asnumpy()
+
+        ts = [threading.Thread(target=step, args=(kv, t))
+              for kv, t in ((kv0, "a"), (kv1, "b"))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        # no updater installed: push-replace with the reduced sum (1+2)
+        np.testing.assert_allclose(outs["a"], 3.0)
+        np.testing.assert_allclose(outs["b"], 3.0)
+        assert kv0._seen_epoch >= 2  # both joins observed on replies
+    finally:
+        _close(kv0, kv1)
+
+
+def test_elastic_compression_error_feedback(monkeypatch):
+    import mxnet_tpu as mx
+
+    kv0, kv1 = _pair(monkeypatch, MXNET_KV_COMPRESS="int8")
+    try:
+        for kv in (kv0, kv1):
+            kv.init(0, mx.nd.array(np.zeros(3, np.float32)))
+        g = np.array([1.0, -0.004, 0.5], np.float32)
+        before = _counter("kvstore.compress_push")
+
+        def step(kv):
+            kv.push(0, mx.nd.array(g))
+            o = mx.nd.array(np.zeros(3, np.float32))
+            kv.pull(0, out=o)
+
+        ts = [threading.Thread(target=step, args=(kv,))
+              for kv in (kv0, kv1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        assert _counter("kvstore.compress_push") >= before + 2
+        # error feedback: the quantization residual of the tiny component
+        # is remembered client-side for the next push
+        res = kv0._residual.get("0")
+        assert res is not None and res.shape == (3,)
+        scale = max(abs(float(np.max(np.abs(g)))), 1e-30) / 127.0
+        np.testing.assert_allclose(
+            res, g - np.clip(np.rint(g / scale), -127, 127) * scale,
+            atol=1e-7)
+    finally:
+        _close(kv0, kv1)
+
+
+def test_elastic_backup_worker_drops_slowest(monkeypatch):
+    import mxnet_tpu as mx
+
+    kv0, kv1 = _pair(monkeypatch, MXNET_KV_BACKUP_WORKERS="1")
+    try:
+        for kv in (kv0, kv1):
+            kv.init(0, mx.nd.array(np.zeros(2, np.float32)))
+        # rank 0 alone closes the round (expected 2, need 2-1=1); the
+        # aggregate is rescaled by expected/arrived = 2
+        kv0.push(0, mx.nd.array(np.ones(2, np.float32)))
+        o = mx.nd.array(np.zeros(2, np.float32))
+        kv0.pull(0, out=o)
+        np.testing.assert_allclose(o.asnumpy(), 2.0)
+        before = _counter("kvstore.drop_slowest")
+        # rank 1's late contribution to the closed round is discarded
+        kv1.push(0, mx.nd.array(np.ones(2, np.float32)))
+        assert _counter("kvstore.drop_slowest") > before
+        # ...and its clock fast-forwards onto the live round line
+        assert kv1._clock["0"] == kv0._clock["0"]
+    finally:
+        _close(kv0, kv1)
+
+
+def test_elastic_corrupt_frame_rejected_not_absorbed(monkeypatch):
+    import mxnet_tpu as mx
+
+    kv0, kv1 = _pair(monkeypatch)
+    try:
+        for kv in (kv0, kv1):
+            kv.init(0, mx.nd.array(np.ones(2, np.float32)))
+        before = _counter("kvstore.corrupt_frame_rejected")
+        # raw garbage straight at the coordinator: detected + refused
+        s = socket.create_connection(kv0._addr, timeout=5)
+        s.sendall(b"GET / HTTP/1.1\r\n\r\n" + b"\x00" * 64)
+        s.settimeout(5)
+        try:
+            while s.recv(4096):
+                pass
+        except OSError:
+            pass
+        s.close()
+        assert _counter("kvstore.corrupt_frame_rejected") > before
+        # the store was not perturbed: a clean pull still works
+        o = mx.nd.array(np.zeros(2, np.float32))
+        kv1.pull(0, out=o)
+        np.testing.assert_allclose(o.asnumpy(), 1.0)
+    finally:
+        _close(kv0, kv1)
+
+
+def test_elastic_chaos_drop_and_corrupt_frames_retry_clean(monkeypatch):
+    import mxnet_tpu as mx
+    from mxnet_tpu import faultinject as _fi
+
+    kv0, kv1 = _pair(monkeypatch)
+    try:
+        for kv in (kv0, kv1):
+            kv.init(0, mx.nd.array(np.zeros(2, np.float32)))
+        _fi.reset()
+        monkeypatch.setenv("MXNET_FI_KV_DROP_EVERY", "3")
+        monkeypatch.setenv("MXNET_FI_KV_CORRUPT_EVERY", "4")
+        monkeypatch.setenv("MXNET_FI_ATTEMPT", "-1")
+        outs = {}
+
+        def steps(kv, tag):
+            for c in range(4):
+                kv.push(0, mx.nd.array(np.ones(2, np.float32)))
+                o = mx.nd.array(np.zeros(2, np.float32))
+                kv.pull(0, out=o)
+                outs[tag] = o.asnumpy()
+
+        ts = [threading.Thread(target=steps, args=(kv, t))
+              for kv, t in ((kv0, "a"), (kv1, "b"))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert "a" in outs and "b" in outs, "chaos run hung"
+        # every round still reduced exactly both contributions (push with
+        # no updater replaces the store with the round's sum: 1 + 1)
+        np.testing.assert_allclose(outs["a"], 2.0)
+        np.testing.assert_allclose(outs["b"], 2.0)
+        assert _counter("faultinject.kv_drop") > 0
+        assert _counter("faultinject.kv_corrupt") > 0
+        # the corrupted frames were DETECTED server-side, then resent clean
+        assert _counter("kvstore.corrupt_frame_rejected") > 0
+    finally:
+        monkeypatch.delenv("MXNET_FI_KV_DROP_EVERY", raising=False)
+        monkeypatch.delenv("MXNET_FI_KV_CORRUPT_EVERY", raising=False)
+        _close(kv0, kv1)
+
+
+def test_elastic_join_bumps_epoch_and_fence_agrees_cursor(monkeypatch):
+    import mxnet_tpu as mx
+
+    kv0, kv1 = _pair(monkeypatch)
+    kv2 = None
+    try:
+        for kv in (kv0, kv1):
+            kv.init(0, mx.nd.array(np.zeros(2, np.float32)))
+        # wait for the startup churn (two joins) to reach both clients via
+        # heartbeat replies, then baseline (set_optimizer's job in fit)
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+                kv0._seen_epoch < 2 or kv1._seen_epoch < 2):
+            time.sleep(0.05)
+        kv0._acked_epoch = kv0._seen_epoch
+        kv1._acked_epoch = kv1._seen_epoch
+        kv2 = ke.ElasticDistKVStore(rank=2, num_workers=3, addr=kv0._addr,
+                                    run_server=False)
+        # survivors observe the join on their next heartbeat reply
+        ev = None
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            ev = kv0.membership_event()
+            if ev is not None and ev.num_workers == 3:
+                break
+            time.sleep(0.05)
+        assert ev is not None and ev.num_workers == 3
+        res = {}
+        ts = [threading.Thread(
+            target=lambda kv=kv, c=c: res.update(
+                {kv.rank: kv.reshard_barrier(*c)}))
+            for kv, c in ((kv0, (5, 40)), (kv1, (5, 37)))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        # joiner was admitted AT the current epoch: fence completes
+        # without it; consensus cursor is the min over survivors
+        assert res[0] == res[1]
+        epoch, nw, ce, cb = res[0]
+        assert nw == 3 and (ce, cb) == (5, 37)
+        assert kv0.num_workers == 3
+        assert kv0.membership_event() is None
+    finally:
+        _close(*(kv for kv in (kv0, kv1, kv2) if kv is not None))
+
+
+def test_elastic_rejected_error_paths_are_typed(monkeypatch):
+    import mxnet_tpu as mx
+
+    kv0, _kv1 = _pair(monkeypatch)
+    try:
+        # pushing a key that was never initialized: typed recovery signal
+        with pytest.raises(kt.ElasticServerLost):
+            kv0.push(99, mx.nd.array(np.zeros(2, np.float32)))
+    finally:
+        _close(kv0, _kv1)

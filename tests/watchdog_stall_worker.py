@@ -1,0 +1,30 @@
+"""Worker for the mesh collective-watchdog end-to-end test.
+
+Both ranks complete barrier 1; rank 1 then stalls (sleeps) and never joins
+barrier 2, so rank 0 blocks inside the XLA collective — the PR-4 watchdog
+(MXNET_KV_TIMEOUT) must convert that silent hang into a diagnosed exit 41
+the supervisor can act on.
+"""
+
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    rank = kv.rank
+    kv.barrier()
+    print(f"rank {rank} barrier 1 done", flush=True)
+    if rank == 1:
+        time.sleep(120)  # stall: never arrives at barrier 2
+        return
+    kv.barrier()  # dead-peer signature; the watchdog exits 41
+    print("rank 0 unexpectedly passed barrier 2", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -172,6 +172,70 @@ def _supervise_local(command, num_workers, coordinator, max_restarts):
         )
 
 
+def _supervise_elastic(command, num_workers, coordinator, max_restarts):
+    """Per-rank restart supervision for the elastic membership plane
+    (``--elastic``): exports ``MXNET_KV_TRANSPORT=tcp`` so the job runs on
+    the live-membership kvstore, under which a single dead rank is NOT a
+    job death — survivors reshard to dp−1 and keep training, so only the
+    dead rank is relaunched, with its OLD rank id (it re-joins as the same
+    member), its per-rank ``MXNET_NUM_RESTARTS`` bumped, and the
+    coordinator/PS-port env preserved (the launcher's port-holder socket
+    keeps the address reserved across the restart).
+
+    Contrast with :func:`_supervise_local`: there the jax runtime pins the
+    world, so any death forces a whole-job relaunch on a fresh port; here
+    the membership table absorbs the churn and the job never loses the
+    survivors' progress.
+    """
+    import time
+
+    job_env = _job_security_env()
+    job_env["MXNET_KV_TRANSPORT"] = "tcp"
+    ps_port, _holder = _alloc_ps_port(coordinator)
+    job_env["MXNET_PS_PORT"] = str(ps_port)
+    restarts = {rank: 0 for rank in range(num_workers)}
+    spent = 0
+
+    def _spawn(rank):
+        return subprocess.Popen(
+            command,
+            env=_worker_env(rank, num_workers, coordinator,
+                            restarts[rank], job_env),
+        )
+
+    procs = {rank: _spawn(rank) for rank in range(num_workers)}
+    while procs:
+        time.sleep(0.2)
+        for rank, p in list(procs.items()):
+            rc = p.poll()
+            if rc is None:
+                continue
+            del procs[rank]
+            if rc == 0:
+                continue
+            if spent >= max_restarts:
+                sys.stderr.write(
+                    f"launch.py: rank {rank} died (rc={rc}), restart "
+                    f"budget spent ({max_restarts}) — job failed\n")
+                for q in procs.values():
+                    q.terminate()
+                for q in procs.values():
+                    try:
+                        q.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        q.kill()
+                        q.wait()
+                return 1
+            spent += 1
+            restarts[rank] += 1
+            sys.stderr.write(
+                f"launch.py: rank {rank} died (rc={rc}); per-rank "
+                f"restart (attempt {restarts[rank]}, budget "
+                f"{spent}/{max_restarts})\n")
+            procs[rank] = _spawn(rank)
+    return 0
+
+
 def main():
     parser = argparse.ArgumentParser(description="Launch a distributed job")
     parser.add_argument("-n", "--num-workers", type=int, required=True)
@@ -181,7 +245,13 @@ def main():
     parser.add_argument("--port", type=int, default=9127)
     parser.add_argument("--max-restarts", type=int, default=0,
                         help="whole-job restarts after any rank failure "
-                             "(local launcher)")
+                             "(local launcher); with --elastic, total "
+                             "per-rank restarts")
+    parser.add_argument("--elastic", action="store_true",
+                        help="run on the elastic membership plane "
+                             "(MXNET_KV_TRANSPORT=tcp): a dead rank is "
+                             "relaunched alone with its old rank id while "
+                             "survivors keep training (local launcher)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.command:
@@ -195,6 +265,11 @@ def main():
 
     coordinator = f"{hosts[0]}:{args.port}"
     if args.launcher == "local":
+        if args.elastic:
+            sys.exit(_supervise_elastic(
+                args.command, args.num_workers, coordinator,
+                args.max_restarts
+            ))
         sys.exit(_supervise_local(
             args.command, args.num_workers, coordinator, args.max_restarts
         ))
